@@ -1,0 +1,25 @@
+"""Test configuration: force a virtual 8-device CPU mesh.
+
+This is the analog of the reference's LocalCluster-based multi-worker tests
+(tests/python/test_with_dask.py:45) — multi-device logic is exercised on one
+host via XLA's host-platform device-count trick (SURVEY.md §4).
+
+NOTE: the interpreter may have imported jax already at startup (site hooks),
+so setting JAX_PLATFORMS in os.environ here is too late for THIS process —
+``jax.config.update`` is the reliable switch as long as no backend has been
+initialized yet. The env vars are still set for subprocesses.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+try:
+    jax.config.update("jax_platforms", "cpu")
+except Exception:  # backends already initialized; tests will use what exists
+    pass

@@ -1,0 +1,108 @@
+"""End-to-end training smoke + correctness oracles (reference analog:
+tests/python/test_basic.py, test_updaters.py)."""
+
+import numpy as np
+import pytest
+
+import xgboost_tpu as xgb
+
+
+def make_binary(n=2000, f=10, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f).astype(np.float32)
+    logit = X[:, 0] * 2.0 - X[:, 1] + 0.5 * X[:, 2] * X[:, 3]
+    y = (logit + rng.randn(n) * 0.5 > 0).astype(np.float32)
+    return X, y
+
+
+def test_train_reduces_logloss_and_overfits_auc():
+    X, y = make_binary()
+    dtrain = xgb.DMatrix(X, label=y)
+    res = {}
+    bst = xgb.train(
+        {"objective": "binary:logistic", "max_depth": 4, "eta": 0.3,
+         "eval_metric": ["logloss", "auc"]},
+        dtrain, num_boost_round=20,
+        evals=[(dtrain, "train")], evals_result=res, verbose_eval=False,
+    )
+    ll = res["train"]["logloss"]
+    assert ll[-1] < ll[0] * 0.7
+    assert res["train"]["auc"][-1] > 0.9
+
+
+def test_regression_fits_function():
+    rng = np.random.RandomState(3)
+    X = rng.uniform(-2, 2, size=(3000, 3)).astype(np.float32)
+    y = X[:, 0] ** 2 + np.sin(X[:, 1]) + 0.1 * rng.randn(3000)
+    dtrain = xgb.DMatrix(X, label=y)
+    bst = xgb.train(
+        {"objective": "reg:squarederror", "max_depth": 5, "eta": 0.3},
+        dtrain, num_boost_round=40, verbose_eval=False,
+    )
+    pred = bst.predict(dtrain)
+    rmse = np.sqrt(np.mean((pred - y) ** 2))
+    assert rmse < 0.35, rmse
+
+
+def test_prediction_cache_matches_full_predict():
+    """UpdatePredictionCache fast path == fresh predictor pass."""
+    X, y = make_binary(800, 6)
+    dtrain = xgb.DMatrix(X, label=y)
+    bst = xgb.train(
+        {"objective": "binary:logistic", "max_depth": 3},
+        dtrain, num_boost_round=5, verbose_eval=False,
+    )
+    cached = bst._caches[id(dtrain)].margin
+    dtrain2 = xgb.DMatrix(X, label=y)
+    fresh = bst.predict(dtrain2, output_margin=True)
+    np.testing.assert_allclose(np.asarray(cached)[:, 0], fresh, rtol=1e-4, atol=1e-5)
+
+
+def test_device_predict_matches_host_walk():
+    X, y = make_binary(300, 5)
+    dtrain = xgb.DMatrix(X, label=y)
+    bst = xgb.train({"objective": "binary:logistic", "max_depth": 4},
+                    dtrain, num_boost_round=3, verbose_eval=False)
+    margin = bst.predict(dtrain, output_margin=True)
+    host = np.full(X.shape[0], bst._base_margin_val, np.float64)
+    for t in bst._gbm.model.trees:
+        for i in range(X.shape[0]):
+            host[i] += t.predict_one(X[i])
+    np.testing.assert_allclose(margin, host, rtol=1e-4, atol=1e-5)
+
+
+def test_missing_values_train_and_default_direction():
+    X, y = make_binary(1000, 5)
+    X[::3, 0] = np.nan
+    dtrain = xgb.DMatrix(X, label=y)
+    bst = xgb.train({"objective": "binary:logistic", "max_depth": 3},
+                    dtrain, num_boost_round=5, verbose_eval=False)
+    p = bst.predict(dtrain)
+    assert np.all(np.isfinite(p))
+
+
+def test_multiclass_softprob():
+    rng = np.random.RandomState(5)
+    X = rng.randn(1500, 6).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] > 0.5).astype(int) + (X[:, 2] > 0).astype(int)
+    dtrain = xgb.DMatrix(X, label=y)
+    res = {}
+    bst = xgb.train(
+        {"objective": "multi:softprob", "num_class": 3, "max_depth": 4,
+         "eval_metric": ["mlogloss", "merror"]},
+        dtrain, num_boost_round=10, evals=[(dtrain, "train")],
+        evals_result=res, verbose_eval=False,
+    )
+    probs = bst.predict(dtrain)
+    assert probs.shape == (1500, 3)
+    np.testing.assert_allclose(probs.sum(1), 1.0, rtol=1e-4)
+    assert res["train"]["merror"][-1] < 0.15
+
+
+def test_max_depth_respected():
+    X, y = make_binary(500, 4)
+    dtrain = xgb.DMatrix(X, label=y)
+    bst = xgb.train({"objective": "binary:logistic", "max_depth": 2},
+                    dtrain, num_boost_round=2, verbose_eval=False)
+    for t in bst._gbm.model.trees:
+        assert t.max_depth() <= 2

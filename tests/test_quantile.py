@@ -1,0 +1,62 @@
+"""Cuts/binning unit tests (reference analog: tests/cpp/common/test_quantile.cc,
+test_hist_util.cc)."""
+
+import numpy as np
+import pytest
+
+from xgboost_tpu.data.quantile import BinnedMatrix, bin_matrix, compute_cuts
+
+
+def test_cuts_monotone_and_cover_max():
+    rng = np.random.RandomState(0)
+    X = rng.randn(500, 4).astype(np.float32)
+    cuts = compute_cuts(X, max_bin=16)
+    assert cuts.values.shape == (4, 16)
+    # each feature's cuts are non-decreasing and the sentinel exceeds max
+    for f in range(4):
+        assert np.all(np.diff(cuts.values[f]) >= 0)
+        assert cuts.values[f, -1] > X[:, f].max()
+
+
+def test_bin_semantics_match_searchsorted():
+    rng = np.random.RandomState(1)
+    X = rng.uniform(-5, 5, size=(300, 3)).astype(np.float32)
+    cuts = compute_cuts(X, max_bin=8)
+    bins = np.asarray(bin_matrix(X, cuts))
+    for f in range(3):
+        expect = np.searchsorted(cuts.values[f], X[:, f], side="right")
+        expect = np.clip(expect, 0, 7)
+        np.testing.assert_array_equal(bins[:, f], expect)
+
+
+def test_missing_goes_to_overflow_bin():
+    X = np.array([[1.0, np.nan], [2.0, 5.0], [np.nan, 6.0]], np.float32)
+    bm = BinnedMatrix.from_dense(X, max_bin=4)
+    bins = np.asarray(bm.bins)
+    assert bins[2, 0] == 4  # missing bin == max_bin
+    assert bins[0, 1] == 4
+
+
+def test_quantile_balance():
+    # uniform data should land roughly equally in all bins
+    rng = np.random.RandomState(2)
+    X = rng.uniform(size=(4096, 1)).astype(np.float32)
+    bm = BinnedMatrix.from_dense(X, max_bin=8)
+    counts = np.bincount(np.asarray(bm.bins)[:, 0], minlength=8)
+    assert counts.min() > 4096 / 8 * 0.7
+
+
+def test_weighted_cuts_shift():
+    # all weight on large values pushes cut points right
+    X = np.linspace(0, 1, 1000).astype(np.float32).reshape(-1, 1)
+    w_hi = (X[:, 0] > 0.8).astype(np.float32) + 0.01
+    cuts_u = compute_cuts(X, max_bin=4)
+    cuts_w = compute_cuts(X, max_bin=4, weights=w_hi)
+    assert cuts_w.values[0, 0] > cuts_u.values[0, 0]
+
+
+def test_all_missing_feature():
+    X = np.full((50, 2), np.nan, np.float32)
+    X[:, 0] = np.arange(50)
+    bm = BinnedMatrix.from_dense(X, max_bin=4)
+    assert np.all(np.asarray(bm.bins)[:, 1] == 4)

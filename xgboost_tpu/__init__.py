@@ -1,0 +1,49 @@
+"""xgboost_tpu: a TPU-native gradient-boosted decision tree framework.
+
+From-scratch JAX/XLA implementation of the capability surface of XGBoost
+(reference surveyed in SURVEY.md): quantile binning, per-node gradient
+histograms, and split evaluation run as fixed-shape XLA programs on TPU
+(``tree_method='tpu_hist'``, the sibling of the reference's ``gpu_hist``),
+with row-sharded data parallelism over TPU meshes via ``jax.lax.psum`` in
+place of rabit/NCCL AllReduce.
+"""
+
+from .config import config_context, get_config, set_config  # noqa: F401
+from .data.dmatrix import DMatrix, QuantileDMatrix  # noqa: F401
+from .learner import Booster  # noqa: F401
+from .training import cv, train  # noqa: F401
+from . import callback  # noqa: F401
+from . import objective  # noqa: F401  (registers objectives)
+from . import metric  # noqa: F401  (registers metrics)
+from .gbm import GBTree, Dart, GBLinear  # noqa: F401
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "DMatrix",
+    "QuantileDMatrix",
+    "Booster",
+    "train",
+    "cv",
+    "callback",
+    "config_context",
+    "set_config",
+    "get_config",
+    "__version__",
+]
+
+
+def __getattr__(name):
+    # soft imports for the sklearn facade (mirrors python-package layout)
+    if name in (
+        "XGBModel",
+        "XGBRegressor",
+        "XGBClassifier",
+        "XGBRanker",
+        "XGBRFRegressor",
+        "XGBRFClassifier",
+    ):
+        from . import sklearn as _sk
+
+        return getattr(_sk, name)
+    raise AttributeError(f"module 'xgboost_tpu' has no attribute '{name}'")

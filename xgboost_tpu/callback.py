@@ -1,0 +1,230 @@
+"""Training callbacks.
+
+Reference: ``python-package/xgboost/callback.py`` — ``TrainingCallback`` ABC
+(:23), ``CallbackContainer`` (:102), ``LearningRateScheduler`` (:239),
+``EarlyStopping`` (:275), ``EvaluationMonitor`` (:434),
+``TrainingCheckPoint`` (:501).
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+from typing import Callable, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+__all__ = [
+    "TrainingCallback",
+    "CallbackContainer",
+    "LearningRateScheduler",
+    "EarlyStopping",
+    "EvaluationMonitor",
+    "TrainingCheckPoint",
+]
+
+_EvalsLog = Dict[str, Dict[str, List[float]]]
+
+
+class TrainingCallback:
+    def before_training(self, model):
+        return model
+
+    def after_training(self, model):
+        return model
+
+    def before_iteration(self, model, epoch: int, evals_log: _EvalsLog) -> bool:
+        return False
+
+    def after_iteration(self, model, epoch: int, evals_log: _EvalsLog) -> bool:
+        """Return True to request training stop."""
+        return False
+
+
+class CallbackContainer:
+    """Drives callbacks around the train loop; owns the evals history."""
+
+    def __init__(
+        self,
+        callbacks: Sequence[TrainingCallback],
+        metric=None,
+        output_margin: bool = True,
+        is_cv: bool = False,
+    ):
+        self.callbacks = list(callbacks)
+        self.metric = metric
+        self.history: _EvalsLog = collections.OrderedDict()
+        self.is_cv = is_cv
+
+    def before_training(self, model):
+        for cb in self.callbacks:
+            model = cb.before_training(model)
+        return model
+
+    def after_training(self, model):
+        for cb in self.callbacks:
+            model = cb.after_training(model)
+        return model
+
+    def before_iteration(self, model, epoch, dtrain, evals) -> bool:
+        return any(cb.before_iteration(model, epoch, self.history) for cb in self.callbacks)
+
+    def _update_history(self, score_strs: str) -> None:
+        # parse "[i]\tname-metric:val\t..." into history
+        for tok in score_strs.split("\t")[1:]:
+            name_metric, _, val = tok.rpartition(":")
+            dname, _, mname = name_metric.partition("-")
+            self.history.setdefault(dname, collections.OrderedDict()).setdefault(
+                mname, []
+            ).append(float(val))
+
+    def after_iteration(self, model, epoch, dtrain, evals, feval=None) -> bool:
+        if evals:
+            msg = model.eval_set(evals, epoch, feval)
+            self._update_history(msg)
+        return any(cb.after_iteration(model, epoch, self.history) for cb in self.callbacks)
+
+
+class LearningRateScheduler(TrainingCallback):
+    """Per-iteration eta override (reference callback.py:239)."""
+
+    def __init__(self, learning_rates: Union[Callable[[int], float], Sequence[float]]):
+        if callable(learning_rates):
+            self.fn = learning_rates
+        else:
+            rates = list(learning_rates)
+            self.fn = lambda epoch: rates[epoch]
+
+    def before_iteration(self, model, epoch, evals_log) -> bool:
+        model.set_param("learning_rate", self.fn(epoch))
+        return False
+
+
+class EarlyStopping(TrainingCallback):
+    """Stop when the watched metric hasn't improved for `rounds`
+    (reference callback.py:275)."""
+
+    def __init__(
+        self,
+        rounds: int,
+        metric_name: Optional[str] = None,
+        data_name: Optional[str] = None,
+        maximize: Optional[bool] = None,
+        save_best: bool = False,
+        min_delta: float = 0.0,
+    ):
+        self.rounds = rounds
+        self.metric_name = metric_name
+        self.data_name = data_name
+        self.maximize = maximize
+        self.save_best = save_best
+        self.min_delta = min_delta
+        self.stopping_history: _EvalsLog = {}
+        self.current_rounds = 0
+        self.best_scores: List[float] = []
+
+    _MAXIMIZE_METRICS = ("auc", "aucpr", "map", "ndcg", "pre", "ams",
+                         "interval-regression-accuracy")
+
+    def before_training(self, model):
+        self.current_rounds = 0
+        self.best_scores = []
+        return model
+
+    def _is_maximize(self, metric: str) -> bool:
+        if self.maximize is not None:
+            return self.maximize
+        base = metric.split("@")[0]
+        return base in self._MAXIMIZE_METRICS
+
+    def after_iteration(self, model, epoch, evals_log) -> bool:
+        if not evals_log:
+            return False
+        data_name = self.data_name or list(evals_log.keys())[-1]
+        metrics = evals_log[data_name]
+        metric_name = self.metric_name or list(metrics.keys())[-1]
+        score = metrics[metric_name][-1]
+        maximize = self._is_maximize(metric_name)
+        if not self.best_scores:
+            improved = True
+        elif maximize:
+            improved = score > self.best_scores[-1] + self.min_delta
+        else:
+            improved = score < self.best_scores[-1] - self.min_delta
+        if improved:
+            self.best_scores.append(score)
+            self.current_rounds = 0
+            if hasattr(model, "set_attr"):
+                model.set_attr(
+                    best_iteration=str(epoch), best_score=f"{score:.9g}"
+                )
+                model.best_iteration = epoch
+                model.best_score = score
+        else:
+            self.current_rounds += 1
+        return self.current_rounds >= self.rounds
+
+    def after_training(self, model):
+        if self.save_best and getattr(model, "best_iteration", None) is not None:
+            model = model[: model.best_iteration + 1]
+        return model
+
+
+class EvaluationMonitor(TrainingCallback):
+    """Print the eval line each period (reference callback.py:434)."""
+
+    def __init__(self, rank: int = 0, period: int = 1, show_stdv: bool = False):
+        self.period = period
+        self.rank = rank
+        self.show_stdv = show_stdv
+        self._latest: Optional[str] = None
+
+    def after_iteration(self, model, epoch, evals_log) -> bool:
+        if not evals_log:
+            return False
+        msg = f"[{epoch}]"
+        for dname, metrics in evals_log.items():
+            for mname, vals in metrics.items():
+                if isinstance(vals[-1], tuple):
+                    mean, std = vals[-1]
+                    msg += f"\t{dname}-{mname}:{mean:.5f}" + (
+                        f"+{std:.5f}" if self.show_stdv else ""
+                    )
+                else:
+                    msg += f"\t{dname}-{mname}:{vals[-1]:.5f}"
+        if epoch % self.period == 0:
+            print(msg, flush=True)
+            self._latest = None
+        else:
+            self._latest = msg
+        return False
+
+    def after_training(self, model):
+        if self._latest is not None:
+            print(self._latest, flush=True)
+        return model
+
+
+class TrainingCheckPoint(TrainingCallback):
+    """Save the model every `interval` iterations (reference callback.py:501)."""
+
+    def __init__(self, directory: str, name: str = "model", as_pickle: bool = False, interval: int = 100):
+        self.directory = directory
+        self.name = name
+        self.as_pickle = as_pickle
+        self.interval = max(1, interval)
+        self._epoch = 0
+
+    def after_iteration(self, model, epoch, evals_log) -> bool:
+        self._epoch += 1
+        if self._epoch % self.interval == 0:
+            ext = "pkl" if self.as_pickle else "json"
+            path = os.path.join(self.directory, f"{self.name}_{epoch}.{ext}")
+            if self.as_pickle:
+                import pickle
+
+                with open(path, "wb") as f:
+                    pickle.dump(model, f)
+            else:
+                model.save_model(path)
+        return False

@@ -1,0 +1,52 @@
+"""Process-wide global configuration.
+
+TPU-native analog of the reference's ``GlobalConfiguration``
+(``include/xgboost/global_config.h:17``) and its Python surface
+``set_config/get_config/config_context`` (``python-package/xgboost/config.py``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Dict, Iterator
+
+_DEFAULTS: Dict[str, Any] = {
+    "verbosity": 1,
+    # use float64 accumulation where supported (analog of the reference's
+    # double-precision histogram option, updater_quantile_hist.cc:90-99)
+    "use_x64": False,
+    # deterministic fixed-point histogram accumulation
+    # (gpu_hist/histogram.cu:81-120 rounding trick)
+    "deterministic_histogram": True,
+}
+
+_local = threading.local()
+
+
+def _state() -> Dict[str, Any]:
+    if not hasattr(_local, "cfg"):
+        _local.cfg = dict(_DEFAULTS)
+    return _local.cfg
+
+
+def set_config(**kwargs: Any) -> None:
+    cfg = _state()
+    for k, v in kwargs.items():
+        if k not in cfg:
+            raise ValueError(f"Unknown global config key: {k}")
+        cfg[k] = v
+
+
+def get_config() -> Dict[str, Any]:
+    return dict(_state())
+
+
+@contextlib.contextmanager
+def config_context(**kwargs: Any) -> Iterator[None]:
+    saved = get_config()
+    set_config(**kwargs)
+    try:
+        yield
+    finally:
+        _state().update(saved)

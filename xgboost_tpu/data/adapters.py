@@ -1,0 +1,138 @@
+"""Input adapters: external formats -> dense float32 + NaN-missing.
+
+Analog of the reference's adapter layer (``src/data/adapter.h``,
+``src/data/array_interface.h``, ``python-package/xgboost/data.py`` dispatch):
+numpy / scipy.sparse / pandas / lists / libsvm+csv files all normalize to a
+single canonical host representation. On TPU (a dense machine) the canonical
+form is a dense ``[n_rows, n_features] float32`` array with ``NaN`` marking
+missing entries — the host-side precursor of the ELLPACK-style padded layout
+(``src/data/ellpack_page.cuh``).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["dispatch_data", "load_svmlight", "load_csv"]
+
+
+def _from_scipy(data: Any, missing: float) -> Tuple[np.ndarray, Optional[List[str]]]:
+    csr = data.tocsr()
+    n, m = csr.shape
+    out = np.full((n, m), np.nan, dtype=np.float32)
+    indptr, indices, values = csr.indptr, csr.indices, csr.data
+    # vectorized CSR -> dense scatter
+    row_ids = np.repeat(np.arange(n), np.diff(indptr))
+    out[row_ids, indices] = values.astype(np.float32)
+    return out, None
+
+
+def _from_pandas(data: Any, missing: float, enable_categorical: bool):
+    import pandas as pd
+
+    feature_names = [str(c) for c in data.columns]
+    feature_types: List[str] = []
+    cols = []
+    for c in data.columns:
+        ser = data[c]
+        if isinstance(ser.dtype, pd.CategoricalDtype):
+            if not enable_categorical:
+                raise ValueError(
+                    f"Column '{c}' is categorical; pass enable_categorical=True"
+                )
+            codes = ser.cat.codes.to_numpy(dtype=np.float32)
+            codes = np.where(codes < 0, np.nan, codes)
+            cols.append(codes)
+            feature_types.append("c")
+        else:
+            arr = ser.to_numpy(dtype=np.float32, na_value=np.nan)
+            cols.append(arr)
+            feature_types.append("q")
+    out = np.stack(cols, axis=1) if cols else np.empty((len(data), 0), np.float32)
+    return out, feature_names, feature_types
+
+
+def load_svmlight(path: str) -> Tuple[np.ndarray, np.ndarray, Optional[np.ndarray]]:
+    """Minimal libsvm text parser (reference: dmlc-core text parsers used via
+    ``DMatrix::Load``, ``src/data/data.cc``). Returns (X, y, qid)."""
+    labels: List[float] = []
+    rows: List[int] = []
+    cols: List[int] = []
+    vals: List[float] = []
+    qids: List[int] = []
+    max_col = -1
+    with open(path, "r") as f:
+        for i, line in enumerate(f):
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            parts = line.split()
+            labels.append(float(parts[0]))
+            for tok in parts[1:]:
+                if tok.startswith("qid:"):
+                    qids.append(int(tok[4:]))
+                    continue
+                k, _, v = tok.partition(":")
+                j = int(k)
+                rows.append(len(labels) - 1)
+                cols.append(j)
+                vals.append(float(v))
+                if j > max_col:
+                    max_col = j
+    n = len(labels)
+    X = np.full((n, max_col + 1), np.nan, dtype=np.float32)
+    if rows:
+        X[np.asarray(rows), np.asarray(cols)] = np.asarray(vals, dtype=np.float32)
+    y = np.asarray(labels, dtype=np.float32)
+    qid = np.asarray(qids, dtype=np.int64) if len(qids) == n else None
+    return X, y, qid
+
+
+def load_csv(path: str, label_column: int = 0) -> Tuple[np.ndarray, np.ndarray]:
+    raw = np.loadtxt(path, delimiter=",", dtype=np.float32, ndmin=2)
+    y = raw[:, label_column].copy()
+    X = np.delete(raw, label_column, axis=1)
+    return X, y
+
+
+def dispatch_data(
+    data: Any,
+    missing: float = np.nan,
+    enable_categorical: bool = False,
+):
+    """Normalize any supported input to (X_dense_f32_nan, feature_names,
+    feature_types, label, qid). label/qid are only set for file URIs."""
+    feature_names = None
+    feature_types = None
+    label = None
+    qid = None
+
+    if isinstance(data, (str, os.PathLike)):
+        uri = str(data)
+        path, _, fmt = uri.partition("?format=")
+        if not fmt:
+            fmt = "csv" if path.endswith(".csv") else "libsvm"
+        if fmt == "csv":
+            X, label = load_csv(path)
+        else:
+            X, label, qid = load_svmlight(path)
+    elif hasattr(data, "tocsr"):  # scipy sparse
+        X, feature_names = _from_scipy(data, missing)
+    elif hasattr(data, "columns") and hasattr(data, "dtypes"):  # pandas
+        X, feature_names, feature_types = _from_pandas(data, missing, enable_categorical)
+    else:
+        X = np.asarray(data, dtype=np.float32)
+        if X.ndim == 1:
+            X = X.reshape(-1, 1)
+        X = X.copy()  # do not mutate caller's array when masking missing
+
+    if X.dtype != np.float32:
+        X = X.astype(np.float32)
+    # apply user missing sentinel (reference: adapters take `missing` and
+    # filter during the adapter sweep, simple_dmatrix.cc)
+    if missing is not None and not (isinstance(missing, float) and np.isnan(missing)):
+        X[X == missing] = np.nan
+    return X, feature_names, feature_types, label, qid

@@ -1,0 +1,222 @@
+"""DMatrix and MetaInfo.
+
+Reference equivalents: ``MetaInfo`` (``include/xgboost/data.h:47-185``),
+``SimpleDMatrix`` (``src/data/simple_dmatrix.cc``), ``DeviceQuantileDMatrix``
+(``src/data/iterative_device_dmatrix.h``), Python ``DMatrix``
+(``python-package/xgboost/core.py:501``).
+
+Host side keeps a canonical dense float32/NaN matrix; the quantized
+device-resident form (BinnedMatrix, the ELLPACK analog) is built lazily on
+first use by the hist updater and cached — mirroring the reference where
+``GetBatches<GHistIndexMatrix>``/``EllpackPage`` materialize on first touch.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .adapters import dispatch_data
+from .quantile import BinnedMatrix, HistogramCuts
+
+__all__ = ["MetaInfo", "DMatrix", "QuantileDMatrix"]
+
+
+class MetaInfo:
+    """Labels, weights, groups, margins, survival bounds, feature metadata."""
+
+    def __init__(self) -> None:
+        self.label: Optional[np.ndarray] = None
+        self.weight: Optional[np.ndarray] = None
+        self.base_margin: Optional[np.ndarray] = None
+        self.group_ptr: Optional[np.ndarray] = None  # [n_groups+1] int64 CSR-style
+        self.label_lower_bound: Optional[np.ndarray] = None
+        self.label_upper_bound: Optional[np.ndarray] = None
+        self.feature_names: Optional[List[str]] = None
+        self.feature_types: Optional[List[str]] = None
+        self.feature_weights: Optional[np.ndarray] = None
+
+    def num_groups(self) -> int:
+        return 0 if self.group_ptr is None else len(self.group_ptr) - 1
+
+    def slice(self, rindex: np.ndarray) -> "MetaInfo":
+        out = MetaInfo()
+        for name in ("label", "weight", "base_margin", "label_lower_bound", "label_upper_bound"):
+            v = getattr(self, name)
+            if v is not None:
+                setattr(out, name, v[rindex])
+        out.feature_names = self.feature_names
+        out.feature_types = self.feature_types
+        out.feature_weights = self.feature_weights
+        # group structure does not survive arbitrary row slicing (same
+        # limitation as the reference's SliceDMatrix for ranking)
+        return out
+
+
+def _group_ptr_from_sizes(sizes: np.ndarray) -> np.ndarray:
+    ptr = np.zeros(len(sizes) + 1, dtype=np.int64)
+    np.cumsum(sizes, out=ptr[1:])
+    return ptr
+
+
+def _group_ptr_from_qid(qid: np.ndarray) -> np.ndarray:
+    if len(qid) == 0:
+        return np.zeros(1, dtype=np.int64)
+    change = np.nonzero(np.diff(qid))[0] + 1
+    return np.concatenate([[0], change, [len(qid)]]).astype(np.int64)
+
+
+class DMatrix:
+    """In-memory data matrix + metadata, the universal training/predict input."""
+
+    def __init__(
+        self,
+        data: Any,
+        label: Any = None,
+        *,
+        weight: Any = None,
+        base_margin: Any = None,
+        missing: float = np.nan,
+        feature_names: Optional[Sequence[str]] = None,
+        feature_types: Optional[Sequence[str]] = None,
+        group: Any = None,
+        qid: Any = None,
+        label_lower_bound: Any = None,
+        label_upper_bound: Any = None,
+        feature_weights: Any = None,
+        enable_categorical: bool = False,
+        nthread: Optional[int] = None,  # accepted for API compat; single-controller
+    ) -> None:
+        X, auto_names, auto_types, auto_label, auto_qid = dispatch_data(
+            data, missing=missing, enable_categorical=enable_categorical
+        )
+        self._data: np.ndarray = X
+        self.info = MetaInfo()
+        self.info.feature_names = list(feature_names) if feature_names else auto_names
+        self.info.feature_types = list(feature_types) if feature_types else auto_types
+        if label is None and auto_label is not None:
+            label = auto_label
+        if qid is None and auto_qid is not None:
+            qid = auto_qid
+        if label is not None:
+            self.set_label(label)
+        if weight is not None:
+            self.set_weight(weight)
+        if base_margin is not None:
+            self.set_base_margin(base_margin)
+        if group is not None:
+            self.set_group(group)
+        if qid is not None:
+            self.info.group_ptr = _group_ptr_from_qid(np.asarray(qid))
+        if label_lower_bound is not None:
+            self.info.label_lower_bound = np.asarray(label_lower_bound, dtype=np.float32)
+        if label_upper_bound is not None:
+            self.info.label_upper_bound = np.asarray(label_upper_bound, dtype=np.float32)
+        if feature_weights is not None:
+            self.info.feature_weights = np.asarray(feature_weights, dtype=np.float32)
+        # lazily-built quantized views keyed by max_bin (analog of the
+        # page cache in SimpleDMatrix::GetBatches)
+        self._binned: Dict[int, BinnedMatrix] = {}
+
+    # ---- metadata setters (reference: MetaInfo::SetInfo, data.cc) ----
+    def set_label(self, label: Any) -> None:
+        self.info.label = np.asarray(label, dtype=np.float32).reshape(-1)
+
+    def set_weight(self, weight: Any) -> None:
+        self.info.weight = np.asarray(weight, dtype=np.float32).reshape(-1)
+
+    def set_base_margin(self, margin: Any) -> None:
+        self.info.base_margin = np.asarray(margin, dtype=np.float32)
+
+    def set_group(self, group: Any) -> None:
+        self.info.group_ptr = _group_ptr_from_sizes(np.asarray(group, dtype=np.int64))
+
+    def get_label(self) -> np.ndarray:
+        return self.info.label if self.info.label is not None else np.empty(0, np.float32)
+
+    def get_weight(self) -> np.ndarray:
+        return self.info.weight if self.info.weight is not None else np.empty(0, np.float32)
+
+    def get_base_margin(self) -> np.ndarray:
+        return (
+            self.info.base_margin
+            if self.info.base_margin is not None
+            else np.empty(0, np.float32)
+        )
+
+    # ---- shape ----
+    def num_row(self) -> int:
+        return int(self._data.shape[0])
+
+    def num_col(self) -> int:
+        return int(self._data.shape[1])
+
+    def num_nonmissing(self) -> int:
+        return int(np.count_nonzero(~np.isnan(self._data)))
+
+    @property
+    def data(self) -> np.ndarray:
+        """Dense [n, F] float32 with NaN missing."""
+        return self._data
+
+    @property
+    def feature_names(self) -> Optional[List[str]]:
+        return self.info.feature_names
+
+    @feature_names.setter
+    def feature_names(self, names: Optional[Sequence[str]]) -> None:
+        self.info.feature_names = list(names) if names is not None else None
+
+    @property
+    def feature_types(self) -> Optional[List[str]]:
+        return self.info.feature_types
+
+    @feature_types.setter
+    def feature_types(self, types: Optional[Sequence[str]]) -> None:
+        self.info.feature_types = list(types) if types is not None else None
+
+    # ---- quantized view ----
+    def get_binned(
+        self, max_bin: int = 256, sketch_weights: Optional[np.ndarray] = None
+    ) -> BinnedMatrix:
+        """Build-or-fetch the quantized matrix for this max_bin (analog of
+        ``GetBatches<GHistIndexMatrix>(BatchParam{max_bin})``)."""
+        bm = self._binned.get(max_bin)
+        if bm is None:
+            bm = BinnedMatrix.from_dense(self._data, max_bin=max_bin, weights=sketch_weights)
+            self._binned[max_bin] = bm
+        return bm
+
+    def slice(self, rindex: Any) -> "DMatrix":
+        rindex = np.asarray(rindex)
+        out = DMatrix.__new__(DMatrix)
+        out._data = self._data[rindex]
+        out.info = self.info.slice(rindex)
+        out._binned = {}
+        return out
+
+
+class QuantileDMatrix(DMatrix):
+    """Quantized-at-construction DMatrix (reference:
+    ``DeviceQuantileDMatrix``/``IterativeDeviceDMatrix``): bins eagerly with
+    either its own sketch or the cuts of a reference DMatrix (so validation
+    sets share the training bin edges)."""
+
+    def __init__(
+        self,
+        data: Any,
+        label: Any = None,
+        *,
+        max_bin: int = 256,
+        ref: Optional[DMatrix] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(data, label, **kwargs)
+        self.max_bin = max_bin
+        cuts: Optional[HistogramCuts] = None
+        if ref is not None and ref._binned:
+            cuts = next(iter(ref._binned.values())).cuts
+        self._binned[max_bin] = BinnedMatrix.from_dense(
+            self._data, max_bin=max_bin, weights=self.info.weight, cuts=cuts
+        )

@@ -1,0 +1,174 @@
+"""Weighted quantile sketch -> HistogramCuts -> binned matrix, TPU-style.
+
+Reference equivalents:
+- CPU WQSummary/GK sketch: ``src/common/quantile.{h,cc}`` (merge/prune).
+- GPU SketchContainer: ``src/common/quantile.{cuh,cu}`` — sort-based.
+- ``HistogramCuts`` / ``SearchBin``: ``src/common/hist_util.h:38``.
+- ELLPACK quantized matrix: ``src/data/ellpack_page.cuh``.
+
+TPU-first design (SURVEY.md §7 hard-part 4): instead of the sequential GK
+merge/prune, each feature's cuts come from a full sort + weighted-CDF
+selection — exactly what the GPU SketchContainer effectively computes, but as
+one fixed-shape XLA program over the dense ``[n, F]`` matrix. Distributed
+merging (the ``quantile.cc:270`` AllReduce site) happens by gathering
+fixed-size per-shard summaries (see ``parallel/sketch.py``).
+
+Bin semantics (identical to the reference's SearchBin/upper_bound):
+``bin(x) = #{cuts[f] <= x}``; a split at bin ``b`` with condition
+``cuts[f][b]`` sends ``x < cuts[f][b]`` (i.e. ``bin <= b``) left. The last
+cut is a sentinel strictly greater than the feature max so every finite
+value lands in ``[0, max_bin)``. Missing values get the dedicated bin id
+``max_bin`` (the ELLPACK null-symbol trick, ``ellpack_page.cuh:109``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["HistogramCuts", "compute_cuts", "bin_matrix", "BinnedMatrix"]
+
+
+@dataclasses.dataclass
+class HistogramCuts:
+    """Per-feature cut thresholds, padded to a uniform ``max_bin`` width.
+
+    values[f, b] is the (upper-exclusive) threshold of bin b. Padding via
+    duplicate thresholds is harmless: duplicated cuts produce empty bins that
+    can never win split evaluation. min_vals is kept for model dumps
+    (reference keeps it for display, hist_util.h).
+    """
+
+    values: np.ndarray  # [n_features, max_bin] float32
+    min_vals: np.ndarray  # [n_features] float32
+
+    @property
+    def max_bin(self) -> int:
+        return int(self.values.shape[1])
+
+    @property
+    def n_features(self) -> int:
+        return int(self.values.shape[0])
+
+    @property
+    def missing_bin(self) -> int:
+        return self.max_bin
+
+
+@partial(jax.jit, static_argnames=("max_bin",))
+def _cuts_kernel(X: jax.Array, weights: jax.Array, max_bin: int):
+    """[n, F] -> ([F, max_bin] cut values, [F] min vals).
+
+    Sort each feature column, build the weighted CDF, and read off
+    ``max_bin - 1`` evenly spaced weighted quantiles plus a strict-upper
+    sentinel cut.
+    """
+    n = X.shape[0]
+    Xt = X.T  # [F, n]
+    valid = ~jnp.isnan(Xt)
+    big = jnp.float32(np.finfo(np.float32).max)
+    keys = jnp.where(valid, Xt, big)  # NaN sorts to the end
+    order = jnp.argsort(keys, axis=1)
+    svals = jnp.take_along_axis(keys, order, axis=1)
+    w = jnp.where(valid, weights[None, :], 0.0)
+    sw = jnp.take_along_axis(w, order, axis=1)
+    cdf = jnp.cumsum(sw, axis=1)  # [F, n]
+    total = cdf[:, -1:]
+
+    # quantile levels for the max_bin-1 interior cuts at k/B of total weight;
+    # the sentinel cut closes the last bin (q_{(B-1)/B}, max]
+    levels = (jnp.arange(1, max_bin, dtype=jnp.float32) / max_bin) * total  # [F, B-1]
+    # first sorted index where cdf >= level  (vectorized searchsorted per row)
+    idx = jax.vmap(lambda c, l: jnp.searchsorted(c, l, side="left"))(cdf, levels)
+    idx = jnp.clip(idx, 0, n - 1)
+    interior = jnp.take_along_axis(svals, idx, axis=1)  # [F, B-1]
+
+    n_valid = valid.sum(axis=1)
+    max_val = jnp.where(n_valid > 0, jnp.take_along_axis(svals, (n_valid - 1)[:, None], axis=1)[:, 0], 0.0)
+    min_val = jnp.where(n_valid > 0, svals[:, 0], 0.0)
+    sentinel = max_val + jnp.maximum(1.0, jnp.abs(max_val))
+    # degenerate all-missing feature: make a monotone dummy cut set
+    interior = jnp.where((n_valid > 0)[:, None], interior, 0.0)
+    cuts = jnp.concatenate([interior, sentinel[:, None]], axis=1)  # [F, B]
+    return cuts, min_val
+
+
+def compute_cuts(
+    X: np.ndarray | jax.Array,
+    max_bin: int = 256,
+    weights: Optional[np.ndarray | jax.Array] = None,
+) -> HistogramCuts:
+    """Entry point, analog of ``SketchOnDMatrix`` (``hist_util.cc:132``)."""
+    X = jnp.asarray(X, dtype=jnp.float32)
+    if weights is None or (hasattr(weights, "size") and weights.size == 0):
+        weights = jnp.ones((X.shape[0],), dtype=jnp.float32)
+    else:
+        weights = jnp.asarray(weights, dtype=jnp.float32)
+    values, min_vals = _cuts_kernel(X, weights, max_bin)
+    return HistogramCuts(values=np.asarray(values), min_vals=np.asarray(min_vals))
+
+
+@jax.jit
+def _bin_kernel(X: jax.Array, cut_values: jax.Array) -> jax.Array:
+    """[n, F] float + [F, B] cuts -> [n, F] int32 bins (missing_bin == B)."""
+    B = cut_values.shape[1]
+
+    def one_feature(cuts_f: jax.Array, col: jax.Array) -> jax.Array:
+        b = jnp.searchsorted(cuts_f, col, side="right").astype(jnp.int32)
+        b = jnp.clip(b, 0, B - 1)
+        return jnp.where(jnp.isnan(col), jnp.int32(B), b)
+
+    return jax.vmap(one_feature, in_axes=(0, 1), out_axes=1)(cut_values, X)
+
+
+def storage_dtype(max_bin: int):
+    """Pick the narrowest storage dtype (reference: runtime-selected
+    uint8/16/32 bin storage, ``hist_util.h:180``)."""
+    if max_bin + 1 <= 255:
+        return jnp.uint8
+    if max_bin + 1 <= 65535:
+        return jnp.uint16
+    return jnp.int32
+
+
+def bin_matrix(X: np.ndarray | jax.Array, cuts: HistogramCuts) -> jax.Array:
+    """Quantize a dense matrix against cuts. Analog of
+    ``GHistIndexMatrix::Init`` / ELLPACK packing (``gradient_index.cc:199``)."""
+    Xj = jnp.asarray(X, dtype=jnp.float32)
+    bins = _bin_kernel(Xj, jnp.asarray(cuts.values))
+    return bins.astype(storage_dtype(cuts.max_bin))
+
+
+@dataclasses.dataclass
+class BinnedMatrix:
+    """The quantized training matrix: TPU analog of GHistIndexMatrix /
+    EllpackPage. Dense [n_rows, n_features] narrow-int bin ids on device,
+    missing encoded as ``cuts.max_bin``."""
+
+    cuts: HistogramCuts
+    bins: jax.Array  # [n_rows, n_features] narrow int
+
+    @property
+    def n_rows(self) -> int:
+        return int(self.bins.shape[0])
+
+    @property
+    def n_features(self) -> int:
+        return int(self.bins.shape[1])
+
+    @classmethod
+    def from_dense(
+        cls,
+        X: np.ndarray | jax.Array,
+        max_bin: int = 256,
+        weights: Optional[np.ndarray] = None,
+        cuts: Optional[HistogramCuts] = None,
+    ) -> "BinnedMatrix":
+        if cuts is None:
+            cuts = compute_cuts(X, max_bin=max_bin, weights=weights)
+        return cls(cuts=cuts, bins=bin_matrix(X, cuts))

@@ -1,0 +1,7 @@
+from .gbtree import GBTree, Dart, GBTreeModel  # noqa: F401
+from .gblinear import GBLinear  # noqa: F401
+from ..registry import BOOSTERS
+
+
+def create_booster(name: str, *args, **kwargs):
+    return BOOSTERS.create(name, *args, **kwargs)

@@ -1,0 +1,280 @@
+"""GBTree / DART boosters.
+
+Reference: ``src/gbm/gbtree.{h,cc}`` — ``DoBoost`` (gbtree.cc:219) slices
+per-group gradients, ``BoostNewTrees`` (:319) runs the updater chain, and
+``CommitModel`` (:364) appends trees + updates the prediction cache; DART
+subclass at gbtree.cc:637-1020 (drop/normalize logic mirrored here line by
+line from DropTrees:914 / NormalizeTrees:963).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..params import GBTreeParam, TrainParam
+from ..predictor import StackedForest, predict_leaf, predict_margin, stack_forest
+from ..registry import BOOSTERS
+from ..tree.grow import GrowParams, grow_tree, leaf_value_map, prune_heap
+from ..tree.model import RegTree
+from ..tree.param import SplitParams
+from ..utils import console_logger
+
+
+class GBTreeModel:
+    """Tree collection + group ids (reference: ``src/gbm/gbtree_model.h``)."""
+
+    def __init__(self, n_groups: int = 1):
+        self.n_groups = n_groups
+        self.trees: List[RegTree] = []
+        self.tree_info: List[int] = []
+        self._stacked: Optional[StackedForest] = None
+
+    def add(self, tree: RegTree, group: int) -> None:
+        self.trees.append(tree)
+        self.tree_info.append(group)
+        self._stacked = None
+
+    @property
+    def num_trees(self) -> int:
+        return len(self.trees)
+
+    def stacked(self) -> StackedForest:
+        if self._stacked is None:
+            self._stacked = stack_forest(self.trees, self.tree_info, self.n_groups)
+        return self._stacked
+
+    def slice(self, begin: int, end: int, step: int = 1) -> "GBTreeModel":
+        out = GBTreeModel(self.n_groups)
+        # layered slicing: rounds -> trees_per_round trees (gbtree slicing
+        # semantics operate on boosting rounds)
+        per_round = max(1, self.n_groups)
+        for r in range(begin, end, step):
+            for t in range(r * per_round, min((r + 1) * per_round, len(self.trees))):
+                out.add(self.trees[t], self.tree_info[t])
+        return out
+
+
+@BOOSTERS.register("gbtree")
+class GBTree:
+    """Boosting orchestration over the tpu_hist grower."""
+
+    name = "gbtree"
+
+    def __init__(self, n_groups: int, params: Dict[str, Any]):
+        self.n_groups = max(1, n_groups)
+        self.gbtree_param = GBTreeParam()
+        rest = self.gbtree_param.update(dict(params))
+        self.train_param = TrainParam()
+        self.train_param.update(rest)
+        self.model = GBTreeModel(self.n_groups)
+        self._configure_method()
+
+    def _configure_method(self) -> None:
+        tm = self.gbtree_param.tree_method
+        # every quantile-hist family method maps onto the tpu_hist grower;
+        # exact has no TPU-native analog (data-dependent column scans) — the
+        # reference's GPU path makes the same substitution
+        if tm == "exact":
+            console_logger.warning(
+                "tree_method='exact' is not TPU-native; using 'tpu_hist' "
+                "(same substitution the reference makes for gpu_hist)"
+            )
+        elif tm not in ("auto", "hist", "gpu_hist", "tpu_hist", "approx"):
+            raise ValueError(f"Unknown tree_method: {tm}")
+
+    def _grow_params(self, axis_name: Optional[str] = None) -> GrowParams:
+        tp = self.train_param
+        return GrowParams(
+            max_depth=tp.max_depth,
+            subsample=tp.subsample,
+            colsample_bytree=tp.colsample_bytree,
+            colsample_bylevel=tp.colsample_bylevel,
+            colsample_bynode=tp.colsample_bynode,
+            split=SplitParams(
+                reg_lambda=tp.reg_lambda,
+                reg_alpha=tp.reg_alpha,
+                max_delta_step=tp.max_delta_step,
+                min_child_weight=tp.min_child_weight,
+                min_split_loss=tp.gamma,
+            ),
+            axis_name=axis_name,
+        )
+
+    def set_param(self, key: str, value: Any) -> None:
+        rest = self.gbtree_param.update({key: value})
+        self.train_param.update(rest)
+
+    # ------------------------------------------------------------------
+    def boost_one_round(
+        self,
+        binned,
+        grad: jax.Array,  # [n, K]
+        hess: jax.Array,
+        iteration: int,
+        margin_cache: Optional[jax.Array],  # [n, K] updated in place-ish
+    ) -> Tuple[List[RegTree], Optional[jax.Array]]:
+        """One boosting round: K groups x num_parallel_tree new trees.
+        Returns (new trees, updated margin cache). The cache update is the
+        UpdatePredictionCache fast path — leaf values gathered at each row's
+        final grower position, no predictor pass (gbtree.cc:219)."""
+        tp = self.train_param
+        cfg = self._grow_params()
+        cuts = binned.cuts
+        cut_vals = jnp.asarray(cuts.values)
+        new_trees: List[RegTree] = []
+        for k in range(self.n_groups):
+            g = grad[:, k] if grad.ndim == 2 else grad
+            h = hess[:, k] if hess.ndim == 2 else hess
+            for ptree in range(self.gbtree_param.num_parallel_tree):
+                key = jax.random.PRNGKey(
+                    (tp.seed * 1000003 + iteration * 131 + k * 17 + ptree) & 0x7FFFFFFF
+                )
+                heap = grow_tree(binned.bins, g, h, cut_vals, key, cfg)
+                is_split = np.asarray(heap.is_split)
+                loss_chg = np.asarray(heap.loss_chg)
+                pruned = prune_heap(is_split, loss_chg, tp.gamma)
+                tree = RegTree.from_heap(
+                    pruned,
+                    np.asarray(heap.feature),
+                    np.asarray(heap.split_cond),
+                    np.asarray(heap.default_left),
+                    np.asarray(heap.node_weight),
+                    loss_chg,
+                    np.asarray(heap.node_h),
+                    eta=tp.eta,
+                )
+                self.model.add(tree, k)
+                new_trees.append(tree)
+                if margin_cache is not None:
+                    lmap = jnp.asarray(leaf_value_map(pruned, np.asarray(heap.node_weight), tp.eta))
+                    delta = lmap[heap.positions]
+                    if margin_cache.ndim == 2:
+                        margin_cache = margin_cache.at[:, k].add(delta)
+                    else:
+                        margin_cache = margin_cache + delta
+        return new_trees, margin_cache
+
+    # ------------------------------------------------------------------
+    def training_margin(self, X, base_margin: jax.Array) -> jax.Array:
+        """Margin used to compute this round's gradients (DART overrides to
+        apply dropout)."""
+        return predict_margin(self.model.stacked(), X, base_margin)
+
+    def tree_weights(self) -> Optional[jax.Array]:
+        return None
+
+    def predict(self, X, base_margin: jax.Array) -> jax.Array:
+        return predict_margin(self.model.stacked(), X, base_margin, self.tree_weights())
+
+    def predict_leaf(self, X) -> jax.Array:
+        return predict_leaf(self.model.stacked(), X)
+
+    # ------------------------------------------------------------------
+    def save_json(self) -> dict:
+        return {
+            "name": self.name,
+            "model": {
+                "gbtree_model_param": {
+                    "num_trees": str(self.model.num_trees),
+                    "size_leaf_vector": "0",
+                },
+                "trees": [t.to_json(i) for i, t in enumerate(self.model.trees)],
+                "tree_info": list(self.model.tree_info),
+            },
+        }
+
+    def load_json(self, j: dict) -> None:
+        m = j["model"]
+        self.model = GBTreeModel(self.n_groups)
+        for tj, info in zip(m["trees"], m["tree_info"]):
+            self.model.add(RegTree.from_json(tj), int(info))
+
+
+@BOOSTERS.register("dart")
+class Dart(GBTree):
+    """DART dropout booster (reference: gbtree.cc:637-1020)."""
+
+    name = "dart"
+
+    def __init__(self, n_groups: int, params: Dict[str, Any]):
+        super().__init__(n_groups, params)
+        self.weight_drop: List[float] = []
+        self._idx_drop: List[int] = []
+        self._rng = np.random.RandomState(self.train_param.seed)
+
+    def _drop_trees(self) -> None:
+        """reference DropTrees (gbtree.cc:914)."""
+        p = self.gbtree_param
+        self._idx_drop = []
+        if p.skip_drop > 0.0 and self._rng.uniform() < p.skip_drop:
+            return
+        W = self.weight_drop
+        if not W:
+            return
+        if p.sample_type == "weighted":
+            sw = sum(W)
+            for i, wi in enumerate(W):
+                if self._rng.uniform() < p.rate_drop * len(W) * wi / max(sw, 1e-30):
+                    self._idx_drop.append(i)
+            if p.one_drop and not self._idx_drop:
+                probs = np.asarray(W) / max(sum(W), 1e-30)
+                self._idx_drop.append(int(self._rng.choice(len(W), p=probs)))
+        else:
+            for i in range(len(W)):
+                if self._rng.uniform() < p.rate_drop:
+                    self._idx_drop.append(i)
+            if p.one_drop and not self._idx_drop:
+                self._idx_drop.append(int(self._rng.randint(len(W))))
+
+    def _normalize_trees(self, n_new: int) -> None:
+        """reference NormalizeTrees (gbtree.cc:963)."""
+        lr = self.train_param.eta / max(n_new, 1)
+        k = len(self._idx_drop)
+        if k == 0:
+            self.weight_drop.extend([1.0] * n_new)
+        elif self.gbtree_param.normalize_type == "forest":
+            factor = 1.0 / (1.0 + lr)
+            for i in self._idx_drop:
+                self.weight_drop[i] *= factor
+            self.weight_drop.extend([factor] * n_new)
+        else:  # "tree"
+            factor = k / (k + lr)
+            for i in self._idx_drop:
+                self.weight_drop[i] *= factor
+            self.weight_drop.extend([1.0 / (k + lr)] * n_new)
+
+    def tree_weights(self) -> Optional[jax.Array]:
+        if not self.weight_drop:
+            return None
+        return jnp.asarray(np.asarray(self.weight_drop, np.float32))
+
+    def training_margin(self, X, base_margin: jax.Array) -> jax.Array:
+        self._drop_trees()
+        tw = np.asarray(self.weight_drop, np.float32)
+        if len(tw):
+            tw = tw.copy()
+            tw[self._idx_drop] = 0.0
+            return predict_margin(self.model.stacked(), X, base_margin, jnp.asarray(tw))
+        return predict_margin(self.model.stacked(), X, base_margin)
+
+    def boost_one_round(self, binned, grad, hess, iteration, margin_cache):
+        # DART cannot use the incremental cache (dropout changes old trees'
+        # weights every round) — reference also disables the cache for DART
+        new_trees, _ = super().boost_one_round(binned, grad, hess, iteration, None)
+        self._normalize_trees(len(new_trees))
+        return new_trees, None
+
+    def save_json(self) -> dict:
+        j = super().save_json()
+        j["name"] = "dart"
+        j["model"] = {"gbtree": j["model"], "weight_drop": list(self.weight_drop)}
+        return j
+
+    def load_json(self, j: dict) -> None:
+        inner = j["model"]["gbtree"]
+        super().load_json({"model": inner})
+        self.weight_drop = [float(x) for x in j["model"]["weight_drop"]]

@@ -1,0 +1,554 @@
+"""Learner / Booster: the API core.
+
+Reference: ``src/learner.cc`` — ``LearnerConfiguration::Configure``
+(:250-357, lazy one-time objective/GBM/metric creation),
+``LearnerImpl::UpdateOneIter`` (:1060 — PredictRaw -> GetGradient ->
+DoBoost), ``BoostOneIter`` (:1088 custom objective), ``EvalOneIter``
+(:1105), LearnerIO JSON model save/load (:659-994), plus the Python
+``Booster`` facade (python-package/xgboost/core.py). Here the two layers
+collapse into one class: there is no C API boundary to cross — the Python
+object IS the learner, and device state (prediction caches) lives in JAX
+arrays.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .data.dmatrix import DMatrix
+from .gbm import create_booster
+from .metric import create_metric
+from .objective import create_objective
+from .params import LearnerParam
+from .registry import BOOSTERS, OBJECTIVES
+from .utils import Monitor, console_logger
+
+__all__ = ["Booster"]
+
+_VERSION = [2, 0, 0]  # this framework's model version triplet
+
+
+class _PredCache:
+    """Versioned prediction cache (reference: PredictionContainer,
+    include/xgboost/predictor.h:242 — tracks how many trees are already
+    folded into the cached margin)."""
+
+    def __init__(self) -> None:
+        self.margin: Optional[jax.Array] = None  # [n, K]
+        self.num_trees: int = 0
+
+
+class Booster:
+    """A trained (or training) gradient-boosted model."""
+
+    def __init__(
+        self,
+        params: Optional[Dict[str, Any]] = None,
+        cache: Optional[Sequence[DMatrix]] = None,
+        model_file: Optional[Union[str, bytes, os.PathLike]] = None,
+    ):
+        self.lparam = LearnerParam()
+        self._extra_params: Dict[str, Any] = {}
+        self._gbm = None
+        self._obj = None
+        self._metrics: List = []
+        self._base_margin_val: float = 0.0
+        self._caches: Dict[int, _PredCache] = {}
+        self._cache_refs: Dict[int, DMatrix] = {}
+        self.attributes_: Dict[str, str] = {}
+        self.best_iteration: Optional[int] = None
+        self.best_score: Optional[float] = None
+        self.monitor = Monitor("Booster")
+        if params:
+            self._apply_params(dict(params))
+        if cache:
+            for d in cache:
+                self._caches[id(d)] = _PredCache()
+                self._cache_refs[id(d)] = d
+        if model_file is not None:
+            self.load_model(model_file)
+
+    # ------------------------------------------------------------------
+    # configuration (lazy, like reference Configure())
+    # ------------------------------------------------------------------
+    def _apply_params(self, params: Dict[str, Any]) -> None:
+        unknown = self.lparam.update(params)
+        self._extra_params.update(unknown)
+        if self.lparam.validate_parameters:
+            self._validate_unknown()
+
+    def _validate_unknown(self) -> None:
+        """validate_parameters (reference: learner.cc:351) — flag keys no
+        component recognized."""
+        from .params import GBLinearParam, GBTreeParam, TrainParam
+
+        known = set()
+        for P in (GBTreeParam, TrainParam, GBLinearParam):
+            known.update(P.FIELDS)
+            for f in P.FIELDS.values():
+                known.update(f.aliases)
+        bad = [k for k in self._extra_params if k not in known]
+        if bad:
+            raise ValueError(f"Unknown parameters: {bad}")
+
+    def set_param(self, params, value=None) -> None:
+        if isinstance(params, str):
+            params = {params: value}
+        elif isinstance(params, (list, tuple)):
+            params = dict(params)
+        self._apply_params(dict(params))
+        if self._gbm is not None:
+            for k, v in params.items():
+                try:
+                    self._gbm.set_param(k, v)
+                except Exception:
+                    pass
+            if self._obj is not None and hasattr(self._obj, "params"):
+                self._obj.params = self.lparam
+        self._metrics = []  # re-resolve on next eval
+
+    def _configure(self) -> None:
+        if self._obj is None:
+            self._obj = create_objective(self.lparam.objective, self.lparam)
+        if self._gbm is None:
+            n_groups = self._obj.n_targets()
+            self._gbm = create_booster(self.lparam.booster, n_groups, self._extra_params)
+        base = self.lparam.base_score
+        if base is None:
+            base = self._obj.default_base_score()
+        self._base_margin_val = float(self._obj.prob_to_margin(float(base)))
+
+    @property
+    def n_groups(self) -> int:
+        self._configure()
+        return self._gbm.n_groups
+
+    # ------------------------------------------------------------------
+    # margins & caches
+    # ------------------------------------------------------------------
+    def _base_margin_for(self, dmat: DMatrix, n: int) -> jax.Array:
+        K = self.n_groups
+        bm = dmat.info.base_margin
+        if bm is not None and bm.size:
+            b = jnp.asarray(bm, jnp.float32)
+            return b.reshape(n, K) if b.ndim != 2 else b
+        return jnp.full((n, K), self._base_margin_val, jnp.float32)
+
+    def _cached_margin(self, dtrain: DMatrix) -> jax.Array:
+        """PredictRaw with cache (reference learner.cc:1075)."""
+        entry = self._caches.setdefault(id(dtrain), _PredCache())
+        self._cache_refs.setdefault(id(dtrain), dtrain)
+        n = dtrain.num_row()
+        num_trees = getattr(self._gbm, "model", None)
+        cur = num_trees.num_trees if num_trees is not None else 0
+        if entry.margin is None or entry.num_trees != cur:
+            base = self._base_margin_for(dtrain, n)
+            if hasattr(self._gbm, "training_margin"):
+                entry.margin = self._gbm.training_margin(dtrain.data, base)
+            else:
+                entry.margin = self._gbm.predict(dtrain.data, base)
+            entry.num_trees = cur
+        return entry.margin
+
+    # ------------------------------------------------------------------
+    # training
+    # ------------------------------------------------------------------
+    def update(self, dtrain: DMatrix, iteration: int, fobj=None) -> None:
+        """One boosting iteration (reference UpdateOneIter learner.cc:1060)."""
+        self._configure()
+        if fobj is not None:
+            margin = self._cached_margin(dtrain)
+            pred = np.asarray(margin)
+            if pred.shape[1] == 1:
+                pred = pred[:, 0]
+            grad, hess = fobj(pred, dtrain)
+            self.boost(dtrain, grad, hess)
+            return
+        with self.monitor.section("GetGradient"):
+            margin = self._cached_margin(dtrain)
+            m = margin[:, 0] if self.n_groups == 1 else margin
+            info = dtrain.info
+            grad, hess = self._obj.get_gradient(
+                m,
+                jnp.asarray(info.label) if info.label is not None else jnp.zeros(dtrain.num_row()),
+                jnp.asarray(info.weight) if info.weight is not None else None,
+                iteration,
+                group_ptr=info.group_ptr,
+                label_lower=jnp.asarray(info.label_lower_bound) if info.label_lower_bound is not None else None,
+                label_upper=jnp.asarray(info.label_upper_bound) if info.label_upper_bound is not None else None,
+            )
+        self._do_boost(dtrain, grad, hess, iteration)
+        self.monitor.maybe_print()
+
+    def boost(self, dtrain: DMatrix, grad, hess) -> None:
+        """Custom-objective boost (reference BoostOneIter learner.cc:1088)."""
+        self._configure()
+        grad = jnp.asarray(np.asarray(grad, np.float32))
+        hess = jnp.asarray(np.asarray(hess, np.float32))
+        self._do_boost(dtrain, grad, hess, iteration=self.num_boosted_rounds())
+
+    def _do_boost(self, dtrain: DMatrix, grad, hess, iteration: int) -> None:
+        entry = self._caches.setdefault(id(dtrain), _PredCache())
+        if self._gbm.name in ("gbtree", "dart"):
+            with self.monitor.section("GetBinned"):
+                binned = dtrain.get_binned(self._gbm.train_param.max_bin, dtrain.info.weight)
+            with self.monitor.section("BoostOneRound"):
+                _, new_margin = self._gbm.boost_one_round(
+                    binned, grad, hess, iteration, entry.margin
+                )
+            if new_margin is not None:
+                entry.margin = new_margin
+                entry.num_trees = self._gbm.model.num_trees
+            else:
+                entry.margin = None  # DART: invalidate
+        else:  # gblinear
+            self._gbm.boost_one_round(dtrain.data, grad, hess, iteration)
+            entry.margin = None
+
+    # ------------------------------------------------------------------
+    # evaluation
+    # ------------------------------------------------------------------
+    def _resolve_metrics(self) -> List:
+        self._configure()
+        if not self._metrics:
+            names = list(self.lparam.eval_metric)
+            if not names and not self.lparam.disable_default_eval_metric:
+                names = [self._obj.default_metric()]
+            self._metrics = [create_metric(n) for n in names]
+        return self._metrics
+
+    def eval_set(self, evals, iteration: int = 0, feval=None, output_margin: bool = True) -> str:
+        self._configure()
+        parts = [f"[{iteration}]"]
+        for dmat, name in evals:
+            margin = self._predict_margin(dmat)
+            preds = self._obj.eval_transform(margin[:, 0] if self.n_groups == 1 else margin)
+            info = dmat.info
+            for metric in self._resolve_metrics():
+                val = metric.evaluate(
+                    preds,
+                    jnp.asarray(info.label) if info.label is not None else jnp.zeros(dmat.num_row()),
+                    info.weight,
+                    group_ptr=info.group_ptr,
+                    label_lower=info.label_lower_bound,
+                    label_upper=info.label_upper_bound,
+                )
+                parts.append(f"{name}-{metric.name}:{val:.6f}")
+            if feval is not None:
+                m = np.asarray(margin)
+                fname, fval = feval(m[:, 0] if m.shape[1] == 1 else m, dmat)
+                parts.append(f"{name}-{fname}:{fval:.6f}")
+        return "\t".join(parts)
+
+    def eval(self, data: DMatrix, name: str = "eval", iteration: int = 0) -> str:
+        return self.eval_set([(data, name)], iteration)
+
+    # ------------------------------------------------------------------
+    # prediction
+    # ------------------------------------------------------------------
+    def _predict_margin(self, dmat: DMatrix, iteration_range=None) -> jax.Array:
+        self._configure()
+        n = dmat.num_row()
+        base = self._base_margin_for(dmat, n)
+        if iteration_range is not None and self._gbm.name in ("gbtree", "dart"):
+            lo, hi = iteration_range
+            if hi == 0:
+                hi = self.num_boosted_rounds()
+            sub = self._gbm.model.slice(lo, hi)
+            from .predictor import predict_margin as _pm
+
+            tw = self._gbm.tree_weights()
+            if tw is not None:
+                per_round = max(1, self._gbm.n_groups) * self._gbm.gbtree_param.num_parallel_tree
+                tw = tw[lo * per_round : hi * per_round]
+            return _pm(sub.stacked(), dmat.data, base, tw)
+        # cache fast path for full-model predictions
+        entry = self._caches.get(id(dmat))
+        cur = self._gbm.model.num_trees if hasattr(self._gbm, "model") else -1
+        if entry is not None and entry.margin is not None and entry.num_trees == cur:
+            return entry.margin
+        return self._gbm.predict(dmat.data, base)
+
+    def predict(
+        self,
+        data: DMatrix,
+        output_margin: bool = False,
+        pred_leaf: bool = False,
+        pred_contribs: bool = False,
+        approx_contribs: bool = False,
+        pred_interactions: bool = False,
+        validate_features: bool = True,
+        training: bool = False,
+        iteration_range: Optional[Tuple[int, int]] = None,
+        strict_shape: bool = False,
+        ntree_limit: int = 0,
+    ) -> np.ndarray:
+        self._configure()
+        if ntree_limit and iteration_range is None:
+            per_round = max(1, self.n_groups)
+            iteration_range = (0, max(1, ntree_limit // per_round))
+        if pred_leaf:
+            leaves = self._gbm.predict_leaf(data.data)
+            return np.asarray(leaves)
+        if pred_contribs or pred_interactions:
+            from .interpret import predict_contribs, predict_interactions
+
+            if pred_interactions:
+                return predict_interactions(self, data)
+            return predict_contribs(self, data, approx=approx_contribs)
+        margin = self._predict_margin(data, iteration_range)
+        if output_margin:
+            out = margin
+        else:
+            out = self._obj.pred_transform(margin[:, 0] if self.n_groups == 1 else margin)
+        out = np.asarray(out)
+        if out.ndim == 2 and out.shape[1] == 1 and not strict_shape:
+            out = out[:, 0]
+        return out
+
+    def inplace_predict(self, data, iteration_range=None, predict_type="value", missing=np.nan, base_margin=None, validate_features=True, strict_shape=False):
+        """In-place predict from raw arrays, no DMatrix (reference:
+        XGBoosterPredictFromDense c_api.cc:833)."""
+        d = DMatrix(data, missing=missing)
+        if base_margin is not None:
+            d.set_base_margin(base_margin)
+        if predict_type == "margin":
+            return self.predict(d, output_margin=True, iteration_range=iteration_range, strict_shape=strict_shape)
+        return self.predict(d, iteration_range=iteration_range, strict_shape=strict_shape)
+
+    # ------------------------------------------------------------------
+    # model IO (XGBoost-JSON-schema-compatible layout, doc/model.schema)
+    # ------------------------------------------------------------------
+    def save_json(self) -> dict:
+        self._configure()
+        learner = {
+            "learner_model_param": {
+                "base_score": str(
+                    self.lparam.base_score
+                    if self.lparam.base_score is not None
+                    else self._obj.default_base_score()
+                ),
+                "num_class": str(self.lparam.num_class),
+                "num_feature": str(self._num_feature()),
+            },
+            "objective": {"name": self._obj.name},
+            "gradient_booster": self._gbm.save_json(),
+            "attributes": dict(self.attributes_),
+        }
+        return {"version": _VERSION, "learner": learner}
+
+    def _num_feature(self) -> int:
+        for d in self._cache_refs.values():
+            return d.num_col()
+        if getattr(self._gbm, "model", None) and self._gbm.model.trees:
+            return int(max(t.split_indices.max(initial=0) for t in self._gbm.model.trees) + 1)
+        return 0
+
+    def save_raw(self, raw_format: str = "json") -> bytes:
+        return json.dumps(self.save_json()).encode()
+
+    def save_model(self, fname: Union[str, os.PathLike]) -> None:
+        with open(fname, "w") as f:
+            json.dump(self.save_json(), f)
+
+    def load_json(self, j: dict) -> None:
+        learner = j["learner"]
+        lmp = learner["learner_model_param"]
+        self.lparam.update(
+            {
+                "base_score": float(lmp["base_score"]),
+                "num_class": int(lmp.get("num_class", 0)),
+                "objective": learner["objective"]["name"],
+            }
+        )
+        self._obj = None
+        self._gbm = None
+        self._configure()
+        gb = learner["gradient_booster"]
+        name = gb.get("name", "gbtree")
+        if name != self.lparam.booster:
+            self.lparam.update({"booster": name})
+            self._gbm = None
+            self._configure()
+        self._gbm.load_json(gb)
+        self.attributes_ = dict(learner.get("attributes", {}))
+        self._caches.clear()
+
+    def load_model(self, fname: Union[str, bytes, os.PathLike]) -> None:
+        if isinstance(fname, (bytes, bytearray)):
+            self.load_json(json.loads(fname.decode()))
+            return
+        with open(fname) as f:
+            self.load_json(json.load(f))
+
+    def __getstate__(self):
+        # full pickle round-trip incl. config (reference:
+        # XGBoosterSerializeToBuffer / test_pickling.py)
+        state = {
+            "model": self.save_json() if self._gbm is not None else None,
+            "lparam": self.lparam.to_dict(),
+            "extra": dict(self._extra_params),
+            "attributes": dict(self.attributes_),
+        }
+        return state
+
+    def __setstate__(self, state):
+        self.__init__()
+        self.lparam.update({k: v for k, v in state["lparam"].items() if v is not None})
+        self._extra_params = dict(state["extra"])
+        self.attributes_ = dict(state["attributes"])
+        if state["model"] is not None:
+            self.load_json(state["model"])
+
+    def copy(self) -> "Booster":
+        import copy as _copy
+
+        return _copy.deepcopy(self)
+
+    def __copy__(self):
+        return self.copy()
+
+    def __deepcopy__(self, memo):
+        b = Booster()
+        b.__setstate__(json.loads(json.dumps(self.__getstate__(), default=float)))
+        return b
+
+    # ------------------------------------------------------------------
+    # introspection
+    # ------------------------------------------------------------------
+    def num_boosted_rounds(self) -> int:
+        self._configure()
+        if self._gbm.name in ("gbtree", "dart"):
+            per_round = max(1, self.n_groups) * self._gbm.gbtree_param.num_parallel_tree
+            return self._gbm.model.num_trees // per_round
+        return getattr(self._gbm, "n_rounds", 0)
+
+    def num_features(self) -> int:
+        return self._num_feature()
+
+    def attr(self, key: str) -> Optional[str]:
+        return self.attributes_.get(key)
+
+    def set_attr(self, **kwargs) -> None:
+        for k, v in kwargs.items():
+            if v is None:
+                self.attributes_.pop(k, None)
+            else:
+                self.attributes_[k] = str(v)
+
+    def attributes(self) -> Dict[str, str]:
+        return dict(self.attributes_)
+
+    def get_dump(self, fmap: str = "", with_stats: bool = False, dump_format: str = "text") -> List[str]:
+        self._configure()
+        names = None
+        if fmap and os.path.exists(fmap):
+            names = {}
+            with open(fmap) as f:
+                for line in f:
+                    ps = line.split()
+                    if len(ps) >= 2:
+                        names[int(ps[0])] = ps[1]
+            names = [names.get(i, f"f{i}") for i in range(max(names) + 1)] if names else None
+        out = []
+        for t in self._gbm.model.trees:
+            if dump_format == "json":
+                out.append(json.dumps(t.to_json()))
+            else:
+                out.append(t.dump_text(names, with_stats))
+        return out
+
+    def dump_model(self, fout, fmap: str = "", with_stats: bool = False, dump_format: str = "text") -> None:
+        dumps = self.get_dump(fmap, with_stats, dump_format)
+        with open(fout, "w") as f:
+            if dump_format == "json":
+                f.write("[\n" + ",\n".join(dumps) + "\n]")
+            else:
+                for i, d in enumerate(dumps):
+                    f.write(f"booster[{i}]:\n{d}\n")
+
+    def get_score(self, fmap: str = "", importance_type: str = "weight") -> Dict[str, float]:
+        """Feature importances (reference: CalcFeatureScore learner.cc)."""
+        self._configure()
+        gain: Dict[int, float] = {}
+        cover: Dict[int, float] = {}
+        weight: Dict[int, float] = {}
+        for t in self._gbm.model.trees:
+            internal = t.left_children != -1
+            for f, g, c in zip(
+                t.split_indices[internal], t.loss_changes[internal], t.sum_hessian[internal]
+            ):
+                f = int(f)
+                weight[f] = weight.get(f, 0.0) + 1.0
+                gain[f] = gain.get(f, 0.0) + float(g)
+                cover[f] = cover.get(f, 0.0) + float(c)
+        names = None
+        for d in self._cache_refs.values():
+            names = d.feature_names
+            break
+
+        def nm(f: int) -> str:
+            return names[f] if names and f < len(names) else f"f{f}"
+
+        if importance_type == "weight":
+            return {nm(f): v for f, v in weight.items()}
+        if importance_type == "total_gain":
+            return {nm(f): v for f, v in gain.items()}
+        if importance_type == "total_cover":
+            return {nm(f): v for f, v in cover.items()}
+        if importance_type == "gain":
+            return {nm(f): gain[f] / weight[f] for f in gain}
+        if importance_type == "cover":
+            return {nm(f): cover[f] / weight[f] for f in cover}
+        raise ValueError(f"Unknown importance_type: {importance_type}")
+
+    def get_fscore(self, fmap: str = "") -> Dict[str, float]:
+        return self.get_score(fmap, "weight")
+
+    def __getitem__(self, val) -> "Booster":
+        """Layer slicing (reference: Learner::Slice)."""
+        if isinstance(val, int):
+            val = slice(val, val + 1)
+        start = val.start or 0
+        stop = val.stop if val.stop is not None else self.num_boosted_rounds()
+        step = val.step or 1
+        self._configure()
+        out = self.copy()
+        out._gbm.model = out._gbm.model.slice(start, stop, step)
+        out._caches.clear()
+        return out
+
+    def trees_to_dataframe(self, fmap: str = ""):
+        import pandas as pd
+
+        rows = []
+        for ti, t in enumerate(self._gbm.model.trees):
+            for i in range(t.num_nodes):
+                leaf = t.left_children[i] == -1
+                rows.append(
+                    {
+                        "Tree": ti,
+                        "Node": i,
+                        "ID": f"{ti}-{i}",
+                        "Feature": "Leaf" if leaf else f"f{t.split_indices[i]}",
+                        "Split": None if leaf else float(t.split_conditions[i]),
+                        "Yes": None if leaf else f"{ti}-{t.left_children[i]}",
+                        "No": None if leaf else f"{ti}-{t.right_children[i]}",
+                        "Missing": None
+                        if leaf
+                        else (
+                            f"{ti}-{t.left_children[i]}"
+                            if t.default_left[i]
+                            else f"{ti}-{t.right_children[i]}"
+                        ),
+                        "Gain": float(t.split_conditions[i]) if leaf else float(t.loss_changes[i]),
+                        "Cover": float(t.sum_hessian[i]),
+                    }
+                )
+        return pd.DataFrame(rows)

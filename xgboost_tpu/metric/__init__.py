@@ -1,0 +1,6 @@
+from .base import Metric, create_metric  # noqa: F401
+from . import elementwise  # noqa: F401  (registers)
+from . import multiclass  # noqa: F401
+from . import auc  # noqa: F401
+from . import rank  # noqa: F401
+from . import survival  # noqa: F401

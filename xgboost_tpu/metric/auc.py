@@ -1,0 +1,108 @@
+"""AUC / AUC-PR (reference: ``src/metric/auc.{cc,cu,h}`` — binary ROC,
+multiclass one-vs-rest, ranking group-mean; GPU via segmented scans).
+
+TPU design: exact tie handling without ragged blocks — sort by score, build
+tie-block segment ids from score boundaries, and compute
+P(s_pos > s_neg) + 0.5 P(=) with weighted block sums via ``segment_sum``.
+One fixed-shape program; deterministic.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..registry import METRICS
+from .base import Metric
+
+
+@jax.jit
+def _binary_auc(score: jax.Array, label: jax.Array, weight: jax.Array) -> jax.Array:
+    n = score.shape[0]
+    order = jnp.argsort(score)
+    s = score[order]
+    y = label[order]
+    w = weight[order]
+    wp = w * y
+    wn = w * (1.0 - y)
+    # tie blocks
+    newblk = jnp.concatenate([jnp.ones((1,), bool), s[1:] != s[:-1]])
+    seg = jnp.cumsum(newblk) - 1  # [n] block id
+    blk_wn = jax.ops.segment_sum(wn, seg, num_segments=n)  # padded with zeros
+    cum_blk_wn = jnp.concatenate([jnp.zeros((1,)), jnp.cumsum(blk_wn)[:-1]])
+    below = cum_blk_wn[seg]  # neg weight strictly below this block
+    tied = blk_wn[seg]
+    num = (wp * (below + 0.5 * tied)).sum()
+    Wp, Wn = wp.sum(), wn.sum()
+    return jnp.where((Wp > 0) & (Wn > 0), num / jnp.maximum(Wp * Wn, 1e-30), jnp.nan)
+
+
+@METRICS.register("auc")
+class AUC(Metric):
+    name = "auc"
+    maximize = True
+
+    def evaluate(self, preds, label, weight=None, group_ptr=None, **kw):
+        preds = jnp.asarray(preds)
+        label_j = jnp.asarray(label, dtype=jnp.float32)
+        n = label_j.shape[0]
+        w = (
+            jnp.asarray(weight, jnp.float32)
+            if weight is not None and np.size(weight) == n
+            else jnp.ones((n,), jnp.float32)
+        )
+        if preds.ndim == 2 and preds.shape[1] > 1:
+            # multiclass: weighted one-vs-rest average (auc.cc:385)
+            aucs = []
+            for k in range(preds.shape[1]):
+                aucs.append(float(_binary_auc(preds[:, k], (label_j == k).astype(jnp.float32), w)))
+            return float(np.mean(aucs))
+        if preds.ndim == 2:
+            preds = preds[:, 0]
+        if group_ptr is not None and len(group_ptr) > 2:
+            # ranking: mean of per-group AUCs, groups without both classes skipped
+            vals = []
+            pr = np.asarray(preds)
+            lb = np.asarray(label_j)
+            wn = np.asarray(w)
+            for g in range(len(group_ptr) - 1):
+                lo, hi = int(group_ptr[g]), int(group_ptr[g + 1])
+                yl = lb[lo:hi]
+                if yl.min(initial=1) == yl.max(initial=0):
+                    continue
+                vals.append(float(_binary_auc(jnp.asarray(pr[lo:hi]), jnp.asarray(yl), jnp.asarray(wn[lo:hi]))))
+            return float(np.mean(vals)) if vals else float("nan")
+        return float(_binary_auc(preds, label_j, w))
+
+
+@METRICS.register("aucpr")
+class AUCPR(Metric):
+    name = "aucpr"
+    maximize = True
+
+    def evaluate(self, preds, label, weight=None, **kw):
+        p = np.asarray(preds, dtype=np.float64).reshape(-1)
+        y = np.asarray(label, dtype=np.float64)
+        n = len(y)
+        w = (
+            np.asarray(weight, np.float64)
+            if weight is not None and np.size(weight) == n
+            else np.ones(n)
+        )
+        order = np.argsort(-p, kind="stable")
+        y, w, p = y[order], w[order], p[order]
+        tp = np.cumsum(w * y)
+        fp = np.cumsum(w * (1 - y))
+        total_pos = tp[-1]
+        if total_pos <= 0:
+            return float("nan")
+        # evaluate only at tie-block ends
+        ends = np.append(p[1:] != p[:-1], True)
+        tp_e, fp_e = tp[ends], fp[ends]
+        recall = tp_e / total_pos
+        precision = tp_e / np.maximum(tp_e + fp_e, 1e-30)
+        prev_r = np.concatenate([[0.0], recall[:-1]])
+        return float(np.sum((recall - prev_r) * precision))

@@ -1,0 +1,65 @@
+"""Metric base (reference: ``include/xgboost/metric.h``; distributed
+reduction pattern: every metric's final scalar is AllReduce(sum)/
+AllReduce(weight) — e.g. ``elementwise_metric.cu:372``. Here metrics return
+(sum, weight) pairs so the caller can psum them across a mesh before the
+final divide — the exact same contract)."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..registry import METRICS
+
+
+class Metric:
+    name: str = ""
+    # maximize=True metrics (auc, ndcg, map...) flip early-stopping direction
+    maximize: bool = False
+
+    def evaluate(
+        self,
+        preds: jax.Array,  # transformed predictions
+        label: jax.Array,
+        weight: Optional[jax.Array] = None,
+        group_ptr: Optional[np.ndarray] = None,
+        label_lower: Optional[jax.Array] = None,
+        label_upper: Optional[jax.Array] = None,
+    ) -> float:
+        raise NotImplementedError
+
+
+class ElementwiseMetric(Metric):
+    """sum(w * loss(pred, y)) / sum(w), the shape of every metric in
+    elementwise_metric.cu."""
+
+    def loss(self, pred: jax.Array, label: jax.Array) -> jax.Array:
+        raise NotImplementedError
+
+    def finalize(self, s: float, w: float) -> float:
+        return s / w if w > 0 else float("nan")
+
+    def evaluate(self, preds, label, weight=None, **kw):
+        preds = jnp.asarray(preds)
+        label = jnp.asarray(label)
+        if preds.ndim == 2 and preds.shape[1] == 1:
+            preds = preds[:, 0]
+        l = self.loss(preds, label)
+        if weight is not None and weight.size:
+            w = jnp.asarray(weight)
+            s, tw = (l * w).sum(), w.sum()
+        else:
+            s, tw = l.sum(), jnp.float32(l.shape[0])
+        return self.finalize(float(s), float(tw))
+
+
+def create_metric(name: str) -> Metric:
+    from ..registry import create_metric as _create
+
+    m = _create(name)
+    if not m.name:
+        m.name = name
+    return m

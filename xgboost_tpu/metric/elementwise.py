@@ -1,0 +1,143 @@
+"""Elementwise metrics (reference: ``src/metric/elementwise_metric.cu``
+registrations at :386-426)."""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+from ..registry import METRICS
+from .base import ElementwiseMetric
+
+_EPS = 1e-16
+
+
+@METRICS.register("rmse")
+class RMSE(ElementwiseMetric):
+    name = "rmse"
+
+    def loss(self, p, y):
+        return (p - y) ** 2
+
+    def finalize(self, s, w):
+        return math.sqrt(s / w) if w > 0 else float("nan")
+
+
+@METRICS.register("rmsle")
+class RMSLE(ElementwiseMetric):
+    name = "rmsle"
+
+    def loss(self, p, y):
+        return (jnp.log1p(jnp.maximum(p, -1 + 1e-6)) - jnp.log1p(y)) ** 2
+
+    def finalize(self, s, w):
+        return math.sqrt(s / w) if w > 0 else float("nan")
+
+
+@METRICS.register("mae")
+class MAE(ElementwiseMetric):
+    name = "mae"
+
+    def loss(self, p, y):
+        return jnp.abs(p - y)
+
+
+@METRICS.register("mape")
+class MAPE(ElementwiseMetric):
+    name = "mape"
+
+    def loss(self, p, y):
+        return jnp.abs((y - p) / jnp.maximum(jnp.abs(y), _EPS))
+
+
+@METRICS.register("mphe")
+class MPHE(ElementwiseMetric):
+    name = "mphe"
+
+    def loss(self, p, y):
+        z = p - y
+        return jnp.sqrt(1.0 + z * z) - 1.0
+
+
+@METRICS.register("logloss")
+class LogLoss(ElementwiseMetric):
+    name = "logloss"
+
+    def loss(self, p, y):
+        p = jnp.clip(p, _EPS, 1.0 - _EPS)
+        return -(y * jnp.log(p) + (1.0 - y) * jnp.log(1.0 - p))
+
+
+@METRICS.register("error")
+class BinaryError(ElementwiseMetric):
+    name = "error"
+
+    def __init__(self, threshold: float = 0.5):
+        self.t = threshold
+
+    def loss(self, p, y):
+        return ((p > self.t) != (y > 0.5)).astype(jnp.float32)
+
+
+@METRICS.register("error@")
+class BinaryErrorAt(BinaryError):
+    def __init__(self, arg: str, full_name: str = ""):
+        super().__init__(float(arg))
+        self.name = full_name or f"error@{arg}"
+
+
+@METRICS.register("poisson-nloglik")
+class PoissonNLogLik(ElementwiseMetric):
+    name = "poisson-nloglik"
+
+    def loss(self, p, y):
+        p = jnp.maximum(p, _EPS)
+        return p - y * jnp.log(p) + jnp.asarray(_lgamma_approx(y))
+
+
+def _lgamma_approx(y):
+    import jax.lax as lax
+
+    return lax.lgamma(y + 1.0)
+
+
+@METRICS.register("gamma-deviance")
+class GammaDeviance(ElementwiseMetric):
+    name = "gamma-deviance"
+
+    def loss(self, p, y):
+        e = _EPS
+        return jnp.log(p + e) - jnp.log(y + e) + y / (p + e) - 1.0
+
+    def finalize(self, s, w):
+        return 2.0 * s / w if w > 0 else float("nan")
+
+
+@METRICS.register("gamma-nloglik")
+class GammaNLogLik(ElementwiseMetric):
+    name = "gamma-nloglik"
+
+    def loss(self, p, y):
+        # fixed shape psi=1 as the reference
+        p = jnp.maximum(p, _EPS)
+        theta = -1.0 / p
+        a = theta * y - jnp.log(-theta)
+        return -(a - (jnp.log(jnp.maximum(y, _EPS)) + 0.0))  # psi=1 => c = -log y ...
+
+    def finalize(self, s, w):
+        return s / w if w > 0 else float("nan")
+
+
+@METRICS.register("tweedie-nloglik@", "tweedie-nloglik")
+class TweedieNLogLik(ElementwiseMetric):
+    def __init__(self, arg: str = "1.5", full_name: str = ""):
+        self.rho = float(arg)
+        self.name = full_name or f"tweedie-nloglik@{arg}"
+
+    def loss(self, p, y):
+        rho = self.rho
+        p = jnp.maximum(p, _EPS)
+        a = y * jnp.power(p, 1.0 - rho) / (1.0 - rho)
+        b = jnp.power(p, 2.0 - rho) / (2.0 - rho)
+        return -a + b

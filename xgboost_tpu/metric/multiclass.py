@@ -1,0 +1,41 @@
+"""Multiclass metrics (reference: ``src/metric/multiclass_metric.cu``
+merror/mlogloss at :248-252)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..registry import METRICS
+from .base import Metric
+
+_EPS = 1e-16
+
+
+@METRICS.register("merror")
+class MultiError(Metric):
+    name = "merror"
+
+    def evaluate(self, preds, label, weight=None, **kw):
+        preds = jnp.asarray(preds)
+        if preds.ndim == 1:  # class-index predictions (multi:softmax output)
+            yhat = preds
+        else:
+            yhat = jnp.argmax(preds, axis=-1)
+        wrong = (yhat.astype(jnp.int32) != label.astype(jnp.int32)).astype(jnp.float32)
+        if weight is not None and weight.size:
+            return float((wrong * weight).sum() / weight.sum())
+        return float(wrong.mean())
+
+
+@METRICS.register("mlogloss")
+class MultiLogLoss(Metric):
+    name = "mlogloss"
+
+    def evaluate(self, preds, label, weight=None, **kw):
+        p = jnp.asarray(preds)
+        idx = label.astype(jnp.int32)
+        picked = jnp.take_along_axis(p, idx[:, None], axis=1)[:, 0]
+        l = -jnp.log(jnp.clip(picked, _EPS, 1.0))
+        if weight is not None and weight.size:
+            return float((l * weight).sum() / weight.sum())
+        return float(l.mean())

@@ -1,0 +1,108 @@
+"""Ranking metrics (reference: ``src/metric/rank_metric.{cc,cu}`` —
+ams@k, pre@n, ndcg@n, map@n registered at rank_metric.cc:390-406)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..registry import METRICS
+from .base import Metric
+
+
+def _groups(n: int, group_ptr: Optional[np.ndarray]):
+    if group_ptr is None or len(group_ptr) < 2:
+        return np.array([0, n], dtype=np.int64)
+    return np.asarray(group_ptr)
+
+
+class _PerGroupMetric(Metric):
+    maximize = True
+
+    def __init__(self, arg: str = "", full_name: str = ""):
+        self.topn = int(arg) if arg else 0
+        if full_name:
+            self.name = full_name
+
+    def group_score(self, order_desc: np.ndarray, label: np.ndarray) -> float:
+        raise NotImplementedError
+
+    def evaluate(self, preds, label, weight=None, group_ptr=None, **kw):
+        p = np.asarray(preds).reshape(-1)
+        y = np.asarray(label)
+        ptr = _groups(len(y), group_ptr)
+        scores = []
+        for g in range(len(ptr) - 1):
+            lo, hi = int(ptr[g]), int(ptr[g + 1])
+            if hi <= lo:
+                continue
+            order = np.argsort(-p[lo:hi], kind="stable")
+            scores.append(self.group_score(order, y[lo:hi]))
+        return float(np.mean(scores)) if scores else float("nan")
+
+
+@METRICS.register("ndcg@", "ndcg")
+class NDCG(_PerGroupMetric):
+    name = "ndcg"
+
+    def group_score(self, order, y):
+        k = self.topn if self.topn > 0 else len(y)
+        ranked = y[order][:k]
+        gains = 2.0 ** ranked - 1.0
+        discounts = 1.0 / np.log2(np.arange(len(ranked)) + 2.0)
+        dcg = float((gains * discounts).sum())
+        ideal = np.sort(y)[::-1][:k]
+        idcg = float(((2.0 ** ideal - 1.0) * (1.0 / np.log2(np.arange(len(ideal)) + 2.0))).sum())
+        return dcg / idcg if idcg > 0 else 1.0
+
+
+@METRICS.register("map@", "map")
+class MAP(_PerGroupMetric):
+    name = "map"
+
+    def group_score(self, order, y):
+        k = self.topn if self.topn > 0 else len(y)
+        rel = (y[order] > 0).astype(np.float64)[:k]
+        if rel.sum() == 0:
+            return 1.0  # reference counts no-positive groups as 1
+        hits = np.cumsum(rel)
+        prec = hits / (np.arange(len(rel)) + 1.0)
+        return float((prec * rel).sum() / rel.sum())
+
+
+@METRICS.register("pre@", "pre")
+class PrecisionAt(_PerGroupMetric):
+    name = "pre"
+
+    def group_score(self, order, y):
+        k = self.topn if self.topn > 0 else len(y)
+        rel = (y[order] > 0)[:k]
+        return float(rel.sum() / max(k, 1))
+
+
+@METRICS.register("ams@")
+class AMS(Metric):
+    """Approximate median significance (rank_metric.cc)."""
+
+    maximize = True
+
+    def __init__(self, arg: str = "0.15", full_name: str = ""):
+        self.ratio = float(arg)
+        self.name = full_name or f"ams@{arg}"
+
+    def evaluate(self, preds, label, weight=None, **kw):
+        p = np.asarray(preds).reshape(-1)
+        y = np.asarray(label)
+        n = len(y)
+        w = np.asarray(weight) if weight is not None and np.size(weight) == n else np.ones(n)
+        order = np.argsort(-p, kind="stable")
+        ntop = int(self.ratio * n)
+        br = 10.0
+        s = float((w[order][:ntop] * (y[order][:ntop] > 0.5)).sum())
+        b = float((w[order][:ntop] * (y[order][:ntop] <= 0.5)).sum())
+        if b + br <= 0:
+            return 0.0
+        import math
+
+        return math.sqrt(max(0.0, 2.0 * ((s + b + br) * math.log(1.0 + s / (b + br)) - s)))

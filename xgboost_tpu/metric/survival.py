@@ -1,0 +1,61 @@
+"""Survival metrics (reference: ``src/metric/survival_metric.cu`` —
+aft-nloglik / interval-regression-accuracy at :287-293; cox-nloglik in
+rank_metric.cc)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..registry import METRICS
+from .base import Metric
+
+
+@METRICS.register("aft-nloglik")
+class AFTNLogLik(Metric):
+    name = "aft-nloglik"
+
+    def evaluate(self, preds, label, weight=None, label_lower=None, label_upper=None, **kw):
+        import jax.numpy as jnp
+
+        from ..objective.survival import AFT
+
+        obj = AFT()
+        # preds here are exp(margin); recover the margin
+        margin = jnp.log(jnp.maximum(jnp.asarray(preds).reshape(-1), 1e-30))
+        yl = jnp.asarray(label_lower if label_lower is not None else label)
+        yu = jnp.asarray(label_upper if label_upper is not None else label)
+        ll = obj._loglik(margin, yl, yu)
+        n = margin.shape[0]
+        if weight is not None and np.size(weight) == n:
+            w = jnp.asarray(weight)
+            return float(-(ll * w).sum() / w.sum())
+        return float(-ll.mean())
+
+
+@METRICS.register("interval-regression-accuracy")
+class IntervalAccuracy(Metric):
+    name = "interval-regression-accuracy"
+    maximize = True
+
+    def evaluate(self, preds, label, weight=None, label_lower=None, label_upper=None, **kw):
+        p = np.asarray(preds).reshape(-1)
+        yl = np.asarray(label_lower if label_lower is not None else label)
+        yu = np.asarray(label_upper if label_upper is not None else label)
+        ok = (p >= yl) & ((~np.isfinite(yu)) | (p <= yu))
+        return float(ok.mean())
+
+
+@METRICS.register("cox-nloglik")
+class CoxNLogLik(Metric):
+    name = "cox-nloglik"
+
+    def evaluate(self, preds, label, weight=None, **kw):
+        # data sorted by time ascending; preds are exp(margin)
+        e = np.asarray(preds, dtype=np.float64).reshape(-1)
+        y = np.asarray(label, dtype=np.float64)
+        rsum = np.cumsum(e[::-1])[::-1]  # risk-set sums
+        events = y > 0
+        if events.sum() == 0:
+            return float("nan")
+        ll = np.log(np.maximum(e[events], 1e-30)) - np.log(np.maximum(rsum[events], 1e-30))
+        return float(-ll.sum() / events.sum())

@@ -1,0 +1,14 @@
+"""Objective functions.
+
+Reference: ``src/objective/`` — ``regression_obj.cu`` (loss templates in
+``regression_loss.h``, GetGradient pattern at :59-126), ``multiclass_obj.cu``,
+``hinge.cu``, ``rank_obj.cu``, ``aft_obj.cu``. The reference single-sources
+CPU/GPU via ``common::Transform``; here every objective is a pure jnp
+function, so one source serves TPU and host automatically.
+"""
+
+from .base import ObjFunction, create_objective  # noqa: F401
+from . import regression  # noqa: F401  (registers)
+from . import multiclass  # noqa: F401
+from . import ranking  # noqa: F401
+from . import survival  # noqa: F401

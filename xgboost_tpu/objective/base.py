@@ -1,0 +1,81 @@
+"""ObjFunction base class (reference: ``include/xgboost/objective.h``,
+task typing via ObjInfo ``include/xgboost/task.h:22``)."""
+
+from __future__ import annotations
+
+import enum
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..registry import OBJECTIVES
+
+
+class Task(enum.Enum):
+    REGRESSION = "regression"
+    BINARY = "binary"
+    CLASSIFICATION = "classification"
+    RANKING = "ranking"
+    SURVIVAL = "survival"
+
+
+class ObjFunction:
+    """Gradient/hessian provider. Shapes: margin [n] or [n, n_targets]."""
+
+    task: Task = Task.REGRESSION
+    name: str = ""
+
+    def __init__(self, params=None):
+        self.params = params
+
+    def n_targets(self) -> int:
+        return 1
+
+    def get_gradient(
+        self,
+        margin: jax.Array,
+        label: jax.Array,
+        weight: Optional[jax.Array],
+        iteration: int = 0,
+        *,
+        group_ptr: Optional[np.ndarray] = None,
+        label_lower: Optional[jax.Array] = None,
+        label_upper: Optional[jax.Array] = None,
+    ) -> Tuple[jax.Array, jax.Array]:
+        raise NotImplementedError
+
+    # margin -> user-facing prediction (reference: PredTransform)
+    def pred_transform(self, margin: jax.Array) -> jax.Array:
+        return margin
+
+    # same but for evaluation-time predictions (softmax differs)
+    def eval_transform(self, margin: jax.Array) -> jax.Array:
+        return self.pred_transform(margin)
+
+    # base_score (prob space) -> initial margin (reference: ProbToMargin)
+    def prob_to_margin(self, base_score: float) -> float:
+        return base_score
+
+    def default_base_score(self) -> float:
+        return 0.5
+
+    def default_metric(self) -> str:
+        return "rmse"
+
+
+def create_objective(name: str, params=None) -> ObjFunction:
+    obj = OBJECTIVES.create(name, params)
+    obj.name = OBJECTIVES.resolve(name)
+    return obj
+
+
+def apply_weight(
+    grad: jax.Array, hess: jax.Array, weight: Optional[jax.Array]
+) -> Tuple[jax.Array, jax.Array]:
+    if weight is None:
+        return grad, hess
+    if grad.ndim == 2:
+        weight = weight[:, None]
+    return grad * weight, hess * weight

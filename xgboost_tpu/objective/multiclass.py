@@ -1,0 +1,49 @@
+"""Multiclass objectives (reference: ``src/objective/multiclass_obj.cu`` —
+``multi:softmax``/``multi:softprob`` registered at :198,202)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..registry import OBJECTIVES
+from .base import ObjFunction, Task, apply_weight
+
+_EPS = 1e-16
+
+
+class _SoftmaxBase(ObjFunction):
+    task = Task.CLASSIFICATION
+
+    def n_targets(self) -> int:
+        nc = getattr(self.params, "num_class", 0) if self.params else 0
+        if nc < 2:
+            raise ValueError("multi:* objectives need num_class >= 2")
+        return nc
+
+    def get_gradient(self, margin, label, weight, iteration=0, **kw):
+        # margin [n, K]
+        p = jax.nn.softmax(margin, axis=-1)
+        onehot = jax.nn.one_hot(label.astype(jnp.int32), margin.shape[1], dtype=margin.dtype)
+        grad = p - onehot
+        hess = jnp.maximum(2.0 * p * (1.0 - p), _EPS)
+        return apply_weight(grad, hess, weight)
+
+    def default_metric(self):
+        return "mlogloss"
+
+
+@OBJECTIVES.register("multi:softprob")
+class SoftProb(_SoftmaxBase):
+    def pred_transform(self, margin):
+        return jax.nn.softmax(margin, axis=-1)
+
+
+@OBJECTIVES.register("multi:softmax")
+class SoftMax(_SoftmaxBase):
+    def pred_transform(self, margin):
+        return jnp.argmax(margin, axis=-1).astype(jnp.float32)
+
+    def eval_transform(self, margin):
+        # metrics (merror/mlogloss) need the full distribution
+        return jax.nn.softmax(margin, axis=-1)

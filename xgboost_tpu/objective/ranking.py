@@ -1,0 +1,127 @@
+"""LambdaMART ranking objectives (reference: ``src/objective/rank_obj.cu`` —
+``rank:pairwise``/``rank:ndcg``/``rank:map`` registered at :950-958).
+
+TPU-first design: the reference samples explicit pairs per query group
+(CPU: random pair loops; GPU: SegmentSorter). On TPU we pad each query group
+to a fixed ``max_group_size``, compute ALL pairwise lambdas inside the padded
+[G, S, S] tensor with masking, and weight by |delta metric| for the
+ndcg/map variants — an all-pairs formulation that is a better fit for the
+MXU than sampling, and equivalent to the reference with
+``num_pairsample -> inf`` normalization.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..registry import OBJECTIVES
+from .base import ObjFunction, Task, apply_weight
+
+
+def _pad_groups(group_ptr: np.ndarray) -> Tuple[np.ndarray, int]:
+    sizes = np.diff(group_ptr)
+    max_size = int(sizes.max(initial=1))
+    return sizes, max_size
+
+
+@partial(jax.jit, static_argnames=("n_groups", "max_size", "scheme"))
+def _lambda_grad(
+    margin: jax.Array,  # [n]
+    label: jax.Array,  # [n]
+    group_of: jax.Array,  # [n] int32
+    rank_in_group: jax.Array,  # [n] int32
+    n_groups: int,
+    max_size: int,
+    scheme: str,
+) -> Tuple[jax.Array, jax.Array]:
+    n = margin.shape[0]
+    # scatter rows into padded [G, S] layout
+    flat = group_of * max_size + rank_in_group
+    S = n_groups * max_size
+    pad_margin = jnp.zeros((S,), margin.dtype).at[flat].set(margin).reshape(n_groups, max_size)
+    pad_label = jnp.zeros((S,), label.dtype).at[flat].set(label).reshape(n_groups, max_size)
+    pad_valid = jnp.zeros((S,), bool).at[flat].set(True).reshape(n_groups, max_size)
+
+    def per_group(m, y, v):
+        # all-pairs lambdas within one (padded) group
+        diff_label = y[:, None] - y[None, :]  # >0 where i should rank above j
+        pair = (diff_label > 0) & v[:, None] & v[None, :]
+        s_diff = m[:, None] - m[None, :]
+        # RankNet lambda: sigmoid(-(si - sj)) for positive pairs
+        rho = jax.nn.sigmoid(-s_diff)
+        if scheme == "ndcg":
+            # delta-NDCG weighting: |gain_i - gain_j| * |1/log2(ri+2) - 1/log2(rj+2)| / IDCG
+            order = jnp.argsort(-jnp.where(v, m, -jnp.inf))
+            ranks = jnp.zeros_like(order).at[order].set(jnp.arange(max_size))
+            gains = (2.0 ** y - 1.0)
+            discounts = 1.0 / jnp.log2(ranks.astype(m.dtype) + 2.0)
+            ideal_order = jnp.sort(jnp.where(v, gains, 0.0))[::-1]
+            idcg = (ideal_order / jnp.log2(jnp.arange(max_size, dtype=m.dtype) + 2.0)).sum()
+            idcg = jnp.maximum(idcg, 1e-10)
+            delta = (
+                jnp.abs(gains[:, None] - gains[None, :])
+                * jnp.abs(discounts[:, None] - discounts[None, :])
+                / idcg
+            )
+            w_pair = jnp.where(pair, delta, 0.0)
+        else:  # pairwise (and map approximated by pairwise delta=1)
+            w_pair = jnp.where(pair, 1.0, 0.0)
+        lam = rho * w_pair  # [S, S] contribution for (i above j)
+        hessian = rho * (1.0 - rho) * w_pair
+        grad = -lam.sum(axis=1) + lam.sum(axis=0)  # winners pushed up, losers down
+        hess = hessian.sum(axis=1) + hessian.sum(axis=0)
+        return grad, jnp.maximum(hess, 1e-16)
+
+    g_pad, h_pad = jax.vmap(per_group)(pad_margin, pad_label, pad_valid)
+    grad = g_pad.reshape(-1)[flat]
+    hess = h_pad.reshape(-1)[flat]
+    return grad, hess
+
+
+class _LambdaRankBase(ObjFunction):
+    task = Task.RANKING
+    scheme = "pairwise"
+
+    def get_gradient(self, margin, label, weight, iteration=0, *, group_ptr=None, **kw):
+        n = margin.shape[0]
+        if group_ptr is None:
+            group_ptr = np.array([0, n], dtype=np.int64)
+        sizes = np.diff(group_ptr)
+        n_groups = len(sizes)
+        max_size = int(sizes.max(initial=1))
+        group_of = np.repeat(np.arange(n_groups, dtype=np.int32), sizes)
+        rank_in_group = np.concatenate([np.arange(s, dtype=np.int32) for s in sizes]) if n else np.zeros(0, np.int32)
+        grad, hess = _lambda_grad(
+            margin, label, jnp.asarray(group_of), jnp.asarray(rank_in_group),
+            n_groups, max_size, self.scheme,
+        )
+        # per-group query weights (reference: weights are per-group for ranking)
+        if weight is not None and len(weight) == n_groups:
+            w_row = jnp.asarray(np.repeat(np.asarray(weight), sizes))
+            grad, hess = grad * w_row, hess * w_row
+        elif weight is not None and len(weight) == n:
+            grad, hess = grad * weight, hess * weight
+        return grad, hess
+
+    def default_metric(self):
+        return "map" if self.scheme == "map" else ("ndcg" if self.scheme == "ndcg" else "map")
+
+
+@OBJECTIVES.register("rank:pairwise")
+class RankPairwise(_LambdaRankBase):
+    scheme = "pairwise"
+
+
+@OBJECTIVES.register("rank:ndcg")
+class RankNDCG(_LambdaRankBase):
+    scheme = "ndcg"
+
+
+@OBJECTIVES.register("rank:map")
+class RankMAP(_LambdaRankBase):
+    scheme = "map"

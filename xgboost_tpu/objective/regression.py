@@ -1,0 +1,192 @@
+"""Regression / binary / counts objectives.
+
+Formula parity with ``src/objective/regression_obj.cu`` (registrations at
+:163-183, :189, :298, :400, :485, :599) and ``regression_loss.h``;
+``hinge.cu:95``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..registry import OBJECTIVES
+from .base import ObjFunction, Task, apply_weight
+
+_EPS = 1e-16
+_HESS_EPS = 1e-6
+
+
+def _sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+@OBJECTIVES.register("reg:squarederror", "reg:linear")
+class SquaredError(ObjFunction):
+    def get_gradient(self, margin, label, weight, iteration=0, **kw):
+        grad = margin - label
+        hess = jnp.ones_like(margin)
+        return apply_weight(grad, hess, weight)
+
+    def default_metric(self):
+        return "rmse"
+
+
+@OBJECTIVES.register("reg:squaredlogerror")
+class SquaredLogError(ObjFunction):
+    def get_gradient(self, margin, label, weight, iteration=0, **kw):
+        p = jnp.maximum(margin, -1 + 1e-6)
+        d = jnp.log1p(p) - jnp.log1p(label)
+        grad = d / (p + 1.0)
+        hess = jnp.maximum((-d + 1.0) / ((p + 1.0) ** 2), _HESS_EPS)
+        return apply_weight(grad, hess, weight)
+
+    def default_metric(self):
+        return "rmsle"
+
+
+@OBJECTIVES.register("reg:pseudohubererror")
+class PseudoHuber(ObjFunction):
+    def get_gradient(self, margin, label, weight, iteration=0, **kw):
+        slope = getattr(self.params, "huber_slope", 1.0) if self.params else 1.0
+        z = margin - label
+        scale = 1.0 + (z / slope) ** 2
+        sqrt_s = jnp.sqrt(scale)
+        grad = z / sqrt_s
+        hess = 1.0 / (scale * sqrt_s)
+        return apply_weight(grad, hess, weight)
+
+    def default_metric(self):
+        return "mphe"
+
+
+class _LogisticBase(ObjFunction):
+    task = Task.BINARY
+
+    def get_gradient(self, margin, label, weight, iteration=0, **kw):
+        p = _sigmoid(margin)
+        grad = p - label
+        hess = jnp.maximum(p * (1.0 - p), _EPS)
+        spw = getattr(self.params, "scale_pos_weight", 1.0) if self.params else 1.0
+        if spw != 1.0:
+            w = jnp.where(label == 1.0, spw, 1.0)
+            grad, hess = grad * w, hess * w
+        return apply_weight(grad, hess, weight)
+
+    def prob_to_margin(self, base_score):
+        import math
+
+        base_score = min(max(base_score, 1e-7), 1 - 1e-7)
+        return -math.log(1.0 / base_score - 1.0)
+
+
+@OBJECTIVES.register("binary:logistic")
+class BinaryLogistic(_LogisticBase):
+    def pred_transform(self, margin):
+        return _sigmoid(margin)
+
+    def default_metric(self):
+        return "logloss"
+
+
+@OBJECTIVES.register("reg:logistic")
+class RegLogistic(_LogisticBase):
+    task = Task.REGRESSION
+
+    def pred_transform(self, margin):
+        return _sigmoid(margin)
+
+    def default_metric(self):
+        return "rmse"
+
+
+@OBJECTIVES.register("binary:logitraw")
+class LogitRaw(_LogisticBase):
+    def pred_transform(self, margin):
+        return margin
+
+    def default_metric(self):
+        return "logloss"
+
+
+@OBJECTIVES.register("binary:hinge")
+class Hinge(ObjFunction):
+    task = Task.BINARY
+
+    def get_gradient(self, margin, label, weight, iteration=0, **kw):
+        y = 2.0 * label - 1.0
+        active = y * margin < 1.0
+        grad = jnp.where(active, -y, 0.0)
+        hess = jnp.where(active, 1.0, _HESS_EPS)
+        return apply_weight(grad, hess, weight)
+
+    def pred_transform(self, margin):
+        return (margin > 0.0).astype(jnp.float32)
+
+    def default_metric(self):
+        return "error"
+
+
+@OBJECTIVES.register("count:poisson")
+class Poisson(ObjFunction):
+    def get_gradient(self, margin, label, weight, iteration=0, **kw):
+        e = jnp.exp(margin)
+        grad = e - label
+        hess = e
+        return apply_weight(grad, hess, weight)
+
+    def pred_transform(self, margin):
+        return jnp.exp(margin)
+
+    def prob_to_margin(self, base_score):
+        import math
+
+        return math.log(max(base_score, 1e-16))
+
+    def default_metric(self):
+        return "poisson-nloglik"
+
+
+@OBJECTIVES.register("reg:gamma")
+class GammaDeviance(ObjFunction):
+    def get_gradient(self, margin, label, weight, iteration=0, **kw):
+        expm = jnp.exp(-margin)
+        grad = 1.0 - label * expm
+        hess = jnp.maximum(label * expm, _EPS)
+        return apply_weight(grad, hess, weight)
+
+    def pred_transform(self, margin):
+        return jnp.exp(margin)
+
+    def prob_to_margin(self, base_score):
+        import math
+
+        return math.log(max(base_score, 1e-16))
+
+    def default_metric(self):
+        return "gamma-nloglik"
+
+
+@OBJECTIVES.register("reg:tweedie")
+class Tweedie(ObjFunction):
+    def get_gradient(self, margin, label, weight, iteration=0, **kw):
+        rho = getattr(self.params, "tweedie_variance_power", 1.5) if self.params else 1.5
+        e1 = jnp.exp((1.0 - rho) * margin)
+        e2 = jnp.exp((2.0 - rho) * margin)
+        grad = -label * e1 + e2
+        hess = jnp.maximum(-label * (1.0 - rho) * e1 + (2.0 - rho) * e2, _EPS)
+        return apply_weight(grad, hess, weight)
+
+    def pred_transform(self, margin):
+        return jnp.exp(margin)
+
+    def prob_to_margin(self, base_score):
+        import math
+
+        return math.log(max(base_score, 1e-16))
+
+    def default_metric(self):
+        rho = getattr(self.params, "tweedie_variance_power", 1.5) if self.params else 1.5
+        return f"tweedie-nloglik@{rho}"

@@ -1,0 +1,9 @@
+from .mesh import (  # noqa: F401
+    current_mesh,
+    make_mesh,
+    mesh_context,
+    pad_to_multiple,
+    shard_rows,
+)
+from .grow import distributed_grow_tree  # noqa: F401
+from .sketch import distributed_compute_cuts  # noqa: F401

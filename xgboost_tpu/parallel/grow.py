@@ -1,0 +1,60 @@
+"""Distributed tree growth: shard_map over the row axis.
+
+This is the TPU realization of the reference's inter-node data-parallel
+strategy (SURVEY.md §2.11 item 3): each device holds a row shard, the model
+is replicated, and the only hot-loop synchronization is the per-level
+histogram AllReduce — ``jax.lax.psum`` inside ``grow_tree`` (the analog of
+``SyncHistogramDistributed`` hist/histogram.h:201 and ``AllReduceHist``
+updater_gpu_hist.cu:526). Histogram size is independent of row count, so
+collective cost stays constant as data scales — the same property the
+reference's design relies on.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..tree.grow import GrowParams, HeapTree, grow_tree
+from .mesh import ROW_AXIS
+
+
+def distributed_grow_tree(
+    mesh: Mesh,
+    bins: jax.Array,  # [n, F] row-sharded (n divisible by mesh size)
+    grad: jax.Array,  # [n] row-sharded
+    hess: jax.Array,
+    cut_values: jax.Array,  # [F, B] replicated
+    key: jax.Array,
+    cfg: GrowParams,
+) -> HeapTree:
+    """Grow one tree over row shards. Tree tensors come back replicated
+    (bitwise identical on every device — the property the reference asserts
+    with gpu_hist's debug_synchronize, updater_gpu_hist.cu:49); row
+    positions stay sharded."""
+    cfg_dist = GrowParams(
+        max_depth=cfg.max_depth,
+        subsample=cfg.subsample,
+        colsample_bytree=cfg.colsample_bytree,
+        colsample_bylevel=cfg.colsample_bylevel,
+        colsample_bynode=cfg.colsample_bynode,
+        split=cfg.split,
+        axis_name=ROW_AXIS,
+    )
+
+    fn = jax.shard_map(
+        partial(grow_tree, cfg=cfg_dist),
+        mesh=mesh,
+        in_specs=(P(ROW_AXIS, None), P(ROW_AXIS), P(ROW_AXIS), P(None, None), P()),
+        out_specs=HeapTree(
+            is_split=P(), feature=P(), split_bin=P(), split_cond=P(),
+            default_left=P(), node_g=P(), node_h=P(), node_weight=P(),
+            loss_chg=P(), positions=P(ROW_AXIS),
+        ),
+        check_vma=False,
+    )
+    return fn(bins, grad, hess, cut_values, key)

@@ -1,0 +1,69 @@
+"""Device mesh management: the TPU replacement for the entire rabit
+tracker/socket stack (reference: ``rabit/`` + ``tracker.py`` —
+SURVEY.md §2.10).
+
+Single-controller JAX needs no rendezvous: the mesh IS the cluster
+membership, ranks are mesh coordinates, and the four collective call sites
+of the reference (sketch merge quantile.cc:270, histogram AllReduce
+hist/histogram.h:201, metric sums, num_feature max learner.cc:596) become
+``psum``/``all_gather`` over a named axis. Multi-host: initialize
+``jax.distributed`` and build the mesh over all devices — DCN is handled
+transparently by the runtime.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Iterator, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+ROW_AXIS = "data"  # the one parallel axis of GBDT training: rows
+
+_state = threading.local()
+
+
+def make_mesh(n_devices: Optional[int] = None, devices=None) -> Mesh:
+    """1-D mesh over the row axis (GBDT's only scalable dimension — the
+    'sequence parallelism' analog per SURVEY.md §5: rows sharded, histogram
+    reductions fixed-size)."""
+    if devices is None:
+        devices = jax.devices()
+        if n_devices is not None:
+            devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (ROW_AXIS,))
+
+
+def current_mesh() -> Optional[Mesh]:
+    return getattr(_state, "mesh", None)
+
+
+@contextlib.contextmanager
+def mesh_context(mesh: Optional[Mesh]) -> Iterator[None]:
+    """Activate a mesh: training inside the context shards rows over it."""
+    prev = current_mesh()
+    _state.mesh = mesh
+    try:
+        yield
+    finally:
+        _state.mesh = prev
+
+
+def pad_to_multiple(n: int, k: int) -> int:
+    return ((n + k - 1) // k) * k
+
+
+def shard_rows(arr: jax.Array, mesh: Mesh) -> jax.Array:
+    """Place an array row-sharded over the mesh (rows must divide evenly —
+    pad first; padded rows carry zero gradient/hessian so they are inert,
+    the fixed-shape analog of the reference's empty-worker handling,
+    dask.py:914)."""
+    spec = P(ROW_AXIS, *([None] * (arr.ndim - 1)))
+    return jax.device_put(arr, NamedSharding(mesh, spec))
+
+
+def replicate(arr: jax.Array, mesh: Mesh) -> jax.Array:
+    return jax.device_put(arr, NamedSharding(mesh, P()))

@@ -1,0 +1,208 @@
+"""Declarative parameter structs.
+
+TPU-native analog of ``DMLC_DECLARE_PARAMETER`` (reference:
+``dmlc/parameter.h`` usage in ``src/tree/param.h``,
+``src/gbm/gbtree.h:61``, ``include/xgboost/generic_parameters.h:15``):
+each component owns a parameter struct with defaults, bounds, aliases, and
+unknown-key collection, so ``validate_parameters`` can flag typos the same
+way ``learner.cc:351`` does.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+
+@dataclasses.dataclass
+class Field:
+    default: Any
+    aliases: Tuple[str, ...] = ()
+    lower: Optional[float] = None
+    upper: Optional[float] = None
+    doc: str = ""
+    # parse: str -> value coercion (params often arrive as strings, as in the
+    # reference's key=value config files, src/common/config.h)
+    parse: Optional[Callable[[Any], Any]] = None
+
+
+def _coerce(value: Any, default: Any, parse: Optional[Callable]) -> Any:
+    if parse is not None:
+        return parse(value)
+    if default is None:
+        return value
+    t = type(default)
+    if t is bool:
+        if isinstance(value, str):
+            return value.lower() in ("1", "true", "yes")
+        return bool(value)
+    if t is int:
+        # tolerate "5", 5.0
+        return int(float(value))
+    if t is float:
+        return float(value)
+    if t is str:
+        return str(value)
+    return value
+
+
+class ParamSet:
+    """Base for parameter structs. Subclasses define FIELDS."""
+
+    FIELDS: Dict[str, Field] = {}
+
+    def __init__(self, **kwargs: Any):
+        self._explicit: set = set()
+        for name, f in self.FIELDS.items():
+            setattr(self, name, f.default)
+        self.update(kwargs)
+
+    @classmethod
+    def _alias_map(cls) -> Dict[str, str]:
+        m = {}
+        for name, f in cls.FIELDS.items():
+            for a in f.aliases:
+                m[a] = name
+        return m
+
+    def update(self, kwargs: Dict[str, Any]) -> Dict[str, Any]:
+        """Apply known keys; return dict of unknown keys (for chaining into
+        other ParamSets / validate_parameters)."""
+        unknown: Dict[str, Any] = {}
+        amap = self._alias_map()
+        for key, value in kwargs.items():
+            name = amap.get(key, key)
+            f = self.FIELDS.get(name)
+            if f is None:
+                unknown[key] = value
+                continue
+            v = _coerce(value, f.default, f.parse)
+            if f.lower is not None and isinstance(v, (int, float)) and v < f.lower:
+                raise ValueError(f"{name}={v} below lower bound {f.lower}")
+            if f.upper is not None and isinstance(v, (int, float)) and v > f.upper:
+                raise ValueError(f"{name}={v} above upper bound {f.upper}")
+            setattr(self, name, v)
+            self._explicit.add(name)
+        return unknown
+
+    def is_explicit(self, name: str) -> bool:
+        return name in self._explicit
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {name: getattr(self, name) for name in self.FIELDS}
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"{type(self).__name__}({self.to_dict()})"
+
+
+def _parse_constraint_list(v: Any) -> Any:
+    """Parse "(1,-1,0)" style monotone constraint strings (reference:
+    src/tree/param.h ParseInteractionConstraint)."""
+    if isinstance(v, str):
+        s = v.strip().strip("()")
+        if not s:
+            return []
+        return [int(x) for x in s.replace(" ", "").split(",")]
+    return list(v)
+
+
+def _parse_interaction(v: Any) -> Any:
+    if isinstance(v, str):
+        import json as _json
+
+        s = v.replace("(", "[").replace(")", "]")
+        return _json.loads(s) if s.strip() else []
+    return [list(g) for g in v]
+
+
+class TrainParam(ParamSet):
+    """Tree training hyper-parameters (reference: ``src/tree/param.h``)."""
+
+    FIELDS = {
+        "eta": Field(0.3, aliases=("learning_rate",), lower=0.0),
+        "gamma": Field(0.0, aliases=("min_split_loss",), lower=0.0),
+        "max_depth": Field(6, lower=0),
+        "max_leaves": Field(0, lower=0),
+        "max_bin": Field(256, lower=2),
+        "grow_policy": Field("depthwise"),
+        "min_child_weight": Field(1.0, lower=0.0),
+        "reg_lambda": Field(1.0, aliases=("lambda",), lower=0.0),
+        "reg_alpha": Field(0.0, aliases=("alpha",), lower=0.0),
+        "max_delta_step": Field(0.0, lower=0.0),
+        "subsample": Field(1.0, lower=0.0, upper=1.0),
+        "sampling_method": Field("uniform"),
+        "colsample_bytree": Field(1.0, lower=0.0, upper=1.0),
+        "colsample_bylevel": Field(1.0, lower=0.0, upper=1.0),
+        "colsample_bynode": Field(1.0, lower=0.0, upper=1.0),
+        "monotone_constraints": Field([], parse=_parse_constraint_list),
+        "interaction_constraints": Field([], parse=_parse_interaction),
+        "max_cat_to_onehot": Field(4, lower=1),
+        "sparse_threshold": Field(0.2),
+        "sketch_eps": Field(0.03),
+        "single_precision_histogram": Field(True),
+        "seed": Field(0),
+        # refresh/process_type support (reference: TreeProcessType gbtree.h:42)
+        "refresh_leaf": Field(True),
+    }
+
+
+class GBTreeParam(ParamSet):
+    """Booster-level params (reference: ``src/gbm/gbtree.h:61`` GBTreeTrainParam
+    + DartTrainParam ``gbtree.cc``)."""
+
+    FIELDS = {
+        "tree_method": Field("auto"),
+        "updater": Field(""),
+        "num_parallel_tree": Field(1, lower=1),
+        "process_type": Field("default"),
+        "predictor": Field("auto"),
+        # DART
+        "sample_type": Field("uniform"),
+        "normalize_type": Field("tree"),
+        "rate_drop": Field(0.0, lower=0.0, upper=1.0),
+        "one_drop": Field(False),
+        "skip_drop": Field(0.0, lower=0.0, upper=1.0),
+    }
+
+
+class GBLinearParam(ParamSet):
+    """Linear booster params (reference: ``src/gbm/gblinear.cc``,
+    ``src/linear/coordinate_common.h``)."""
+
+    FIELDS = {
+        "updater": Field("coord_descent"),
+        "feature_selector": Field("cyclic"),
+        "top_k": Field(0, lower=0),
+        "reg_lambda_linear": Field(0.0, aliases=("lambda", "reg_lambda"), lower=0.0),
+        "reg_alpha_linear": Field(0.0, aliases=("alpha", "reg_alpha"), lower=0.0),
+        "eta_linear": Field(0.5, aliases=("eta", "learning_rate"), lower=0.0),
+    }
+
+
+class LearnerParam(ParamSet):
+    """Learner-level params (reference: ``src/learner.cc`` LearnerModelParam /
+    LearnerTrainParam)."""
+
+    FIELDS = {
+        "objective": Field("reg:squarederror"),
+        "booster": Field("gbtree"),
+        "base_score": Field(None),
+        "num_class": Field(0, lower=0),
+        "eval_metric": Field([], parse=lambda v: [v] if isinstance(v, str) else list(v)),
+        "disable_default_eval_metric": Field(False),
+        "seed": Field(0),
+        "nthread": Field(0, aliases=("n_jobs",)),
+        "verbosity": Field(1, lower=0, upper=3),
+        "validate_parameters": Field(False),
+        "multi_strategy": Field("one_output_per_tree"),
+        # scale_pos_weight lives with the objective in the reference
+        # (regression_obj.cu) but is commonly passed at top level.
+        "scale_pos_weight": Field(1.0),
+        "tweedie_variance_power": Field(1.5, lower=1.0, upper=2.0),
+        "huber_slope": Field(1.0),
+        "aft_loss_distribution": Field("normal"),
+        "aft_loss_distribution_scale": Field(1.0),
+        "max_pairs": Field(100),  # ranking pair sampling cap per group
+        "lambdarank_num_pair_per_sample": Field(1, lower=1),
+        "device": Field(""),
+    }

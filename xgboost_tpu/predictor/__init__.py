@@ -1,0 +1,140 @@
+"""TPU predictor: batched tree walk as one XLA program.
+
+Reference: ``src/predictor/gpu_predictor.cu`` (one thread per row, :286) and
+``src/predictor/cpu_predictor.cc`` (block-of-64-rows). TPU-first version:
+all trees are stacked into padded SoA tensors [n_trees, max_nodes]; every
+(row, tree) pair walks via gathers inside a ``lax.fori_loop`` bounded by the
+forest's max depth. No divergence penalty: a finished walk keeps gathering
+its leaf. Missing values route to the default child exactly like
+``predict_fn.h``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class StackedForest(NamedTuple):
+    """Padded SoA forest: [T, N] device tensors + per-tree group ids."""
+
+    left: jax.Array  # int32 [T, N]
+    right: jax.Array  # int32 [T, N]
+    feature: jax.Array  # int32 [T, N]
+    cond: jax.Array  # f32 [T, N] (leaf value at leaves)
+    default_left: jax.Array  # bool [T, N]
+    tree_group: jax.Array  # int32 [T]
+    max_depth: int  # static walk bound
+    n_groups: int
+
+
+def stack_forest(trees, tree_info, n_groups: int) -> StackedForest:
+    """Pad per-tree SoA arrays to a uniform node count and stack."""
+    T = len(trees)
+    if T == 0:
+        z = jnp.zeros((0, 1), jnp.int32)
+        return StackedForest(
+            left=z, right=z, feature=z,
+            cond=jnp.zeros((0, 1), jnp.float32),
+            default_left=jnp.zeros((0, 1), bool),
+            tree_group=jnp.zeros((0,), jnp.int32), max_depth=1, n_groups=n_groups,
+        )
+    N = max(t.num_nodes for t in trees)
+    md = max(max(t.max_depth() for t in trees), 1)
+
+    def pad(a, fill, dtype):
+        out = np.full((T, N), fill, dtype=dtype)
+        for i, t in enumerate(trees):
+            v = a(t)
+            out[i, : len(v)] = v
+        return out
+
+    return StackedForest(
+        left=jnp.asarray(pad(lambda t: t.left_children, -1, np.int32)),
+        right=jnp.asarray(pad(lambda t: t.right_children, -1, np.int32)),
+        feature=jnp.asarray(pad(lambda t: t.split_indices, 0, np.int32)),
+        cond=jnp.asarray(pad(lambda t: t.split_conditions, 0.0, np.float32)),
+        default_left=jnp.asarray(pad(lambda t: t.default_left, False, bool)),
+        tree_group=jnp.asarray(np.asarray(tree_info, np.int32)),
+        max_depth=md,
+        n_groups=n_groups,
+    )
+
+
+@partial(jax.jit, static_argnames=("max_depth",))
+def _walk_leaves(
+    X: jax.Array,  # [n, F] f32 with NaN missing
+    left: jax.Array, right: jax.Array, feature: jax.Array,
+    cond: jax.Array, default_left: jax.Array, max_depth: int,
+) -> jax.Array:
+    """Leaf index of every (tree, row): returns int32 [T, n]."""
+    n = X.shape[0]
+
+    def one_tree(lc, rc, fi, co, dl):
+        pos = jnp.zeros((n,), jnp.int32)
+
+        def body(_, pos):
+            leaf = lc[pos] == -1
+            f = fi[pos]
+            v = jnp.take_along_axis(X, f[:, None], axis=1)[:, 0]
+            goleft = jnp.where(jnp.isnan(v), dl[pos], v < co[pos])
+            nxt = jnp.where(goleft, lc[pos], rc[pos])
+            return jnp.where(leaf, pos, nxt)
+
+        return jax.lax.fori_loop(0, max_depth, body, pos)
+
+    return jax.vmap(one_tree)(left, right, feature, cond, default_left)
+
+
+@partial(jax.jit, static_argnames=("n_groups", "max_depth"))
+def _predict_margin_kernel(
+    X: jax.Array,
+    left, right, feature, cond, default_left, tree_group,
+    tree_weights: jax.Array,  # f32 [T] (DART scaling; ones otherwise)
+    base_margin: jax.Array,  # [n, n_groups]
+    n_groups: int, max_depth: int,
+) -> jax.Array:
+    leaves = _walk_leaves(X, left, right, feature, cond, default_left, max_depth)  # [T, n]
+    leaf_vals = jnp.take_along_axis(cond, leaves, axis=1) * tree_weights[:, None]  # [T, n]
+    # sum per output group (multiclass: one tree per class per round,
+    # reference gbtree.cc:219 gradient slicing)
+    margins = jax.ops.segment_sum(leaf_vals, tree_group, num_segments=n_groups)  # [G, n]
+    return base_margin + margins.T
+
+
+def predict_margin(
+    forest: StackedForest,
+    X: jax.Array,
+    base_margin: jax.Array,
+    tree_weights: Optional[jax.Array] = None,
+) -> jax.Array:
+    """[n, n_groups] raw margins (base + forest sums)."""
+    if forest.left.shape[0] == 0:
+        return base_margin
+    tw = (
+        tree_weights
+        if tree_weights is not None
+        else jnp.ones((forest.left.shape[0],), jnp.float32)
+    )
+    return _predict_margin_kernel(
+        jnp.asarray(X, jnp.float32),
+        forest.left, forest.right, forest.feature, forest.cond,
+        forest.default_left, forest.tree_group, tw, base_margin,
+        forest.n_groups, forest.max_depth,
+    )
+
+
+def predict_leaf(forest: StackedForest, X: jax.Array) -> jax.Array:
+    """[n, T] leaf indices (reference: pred_leaf)."""
+    if forest.left.shape[0] == 0:
+        return jnp.zeros((X.shape[0], 0), jnp.int32)
+    leaves = _walk_leaves(
+        jnp.asarray(X, jnp.float32),
+        forest.left, forest.right, forest.feature, forest.cond,
+        forest.default_left, forest.max_depth,
+    )
+    return leaves.T

@@ -1,0 +1,69 @@
+"""Name-based component registries.
+
+TPU-native analog of ``dmlc::Registry`` (reference:
+``include/xgboost/tree_updater.h:109``, ``include/xgboost/gbm.h:227``,
+``src/objective/objective.cc``): every pluggable algorithm component
+(objective, metric, tree updater, booster, linear updater) is created by
+string name through a registry, so ``tree_method='tpu_hist'`` & friends plug
+in exactly like the reference's ``gpu_hist``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Generic, List, Optional, TypeVar
+
+T = TypeVar("T")
+
+
+class Registry(Generic[T]):
+    """A named factory registry with alias support."""
+
+    def __init__(self, kind: str):
+        self.kind = kind
+        self._factories: Dict[str, Callable[..., T]] = {}
+        self._aliases: Dict[str, str] = {}
+
+    def register(self, name: str, *aliases: str) -> Callable[[Callable[..., T]], Callable[..., T]]:
+        def deco(factory: Callable[..., T]) -> Callable[..., T]:
+            if name in self._factories:
+                raise ValueError(f"{self.kind} '{name}' already registered")
+            self._factories[name] = factory
+            for a in aliases:
+                self._aliases[a] = name
+            return factory
+
+        return deco
+
+    def resolve(self, name: str) -> str:
+        return self._aliases.get(name, name)
+
+    def __contains__(self, name: str) -> bool:
+        return self.resolve(name) in self._factories
+
+    def create(self, name: str, *args: Any, **kwargs: Any) -> T:
+        key = self.resolve(name)
+        if key not in self._factories:
+            known = ", ".join(sorted(self._factories))
+            raise ValueError(f"Unknown {self.kind}: '{name}'. Known: {known}")
+        return self._factories[key](*args, **kwargs)
+
+    def names(self) -> List[str]:
+        return sorted(self._factories)
+
+
+# Global registries, mirroring the reference's set of component families.
+OBJECTIVES: Registry = Registry("objective")
+METRICS: Registry = Registry("metric")
+TREE_UPDATERS: Registry = Registry("tree updater")
+BOOSTERS: Registry = Registry("gradient booster")
+LINEAR_UPDATERS: Registry = Registry("linear updater")
+
+
+def create_metric(name: str):
+    """Create a metric, handling parameterized names like ``error@0.7``,
+    ``ndcg@5`` (reference: ``src/metric/metric.cc`` name parsing)."""
+    if "@" in name:
+        base, _, arg = name.partition("@")
+        if base in METRICS:
+            return METRICS.create(base + "@", arg, full_name=name)
+    return METRICS.create(name)

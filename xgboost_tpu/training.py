@@ -1,0 +1,214 @@
+"""train() / cv() loops (reference: ``python-package/xgboost/training.py`` —
+train at :49, cv + folds at :189-459)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .callback import (
+    CallbackContainer,
+    EarlyStopping,
+    EvaluationMonitor,
+    TrainingCallback,
+)
+from .data.dmatrix import DMatrix
+from .learner import Booster
+
+__all__ = ["train", "cv"]
+
+
+def train(
+    params: Dict[str, Any],
+    dtrain: DMatrix,
+    num_boost_round: int = 10,
+    evals: Optional[Sequence[Tuple[DMatrix, str]]] = None,
+    obj=None,
+    feval=None,
+    maximize: Optional[bool] = None,
+    early_stopping_rounds: Optional[int] = None,
+    evals_result: Optional[dict] = None,
+    verbose_eval: Any = True,
+    xgb_model: Optional[Booster] = None,
+    callbacks: Optional[Sequence[TrainingCallback]] = None,
+    custom_metric=None,
+) -> Booster:
+    callbacks = list(callbacks) if callbacks else []
+    evals = list(evals) if evals else []
+    feval = custom_metric if custom_metric is not None else feval
+
+    if verbose_eval:
+        period = verbose_eval if isinstance(verbose_eval, int) and not isinstance(verbose_eval, bool) else 1
+        callbacks.append(EvaluationMonitor(period=period))
+    if early_stopping_rounds is not None:
+        callbacks.append(EarlyStopping(rounds=early_stopping_rounds, maximize=maximize))
+
+    if xgb_model is not None:
+        from .learner import _PredCache
+
+        bst = xgb_model.copy() if isinstance(xgb_model, Booster) else Booster(params, model_file=xgb_model)
+        bst.set_param(params)
+        for d, _ in [(dtrain, "train")] + evals:
+            bst._caches.setdefault(id(d), _PredCache())
+            bst._cache_refs.setdefault(id(d), d)
+        start_round = bst.num_boosted_rounds()
+    else:
+        bst = Booster(params, cache=[dtrain] + [d for d, _ in evals])
+        start_round = 0
+
+    container = CallbackContainer(callbacks)
+    bst = container.before_training(bst)
+
+    for i in range(start_round, start_round + num_boost_round):
+        if container.before_iteration(bst, i, dtrain, evals):
+            break
+        bst.update(dtrain, i, fobj=obj)
+        if container.after_iteration(bst, i, dtrain, evals, feval=feval):
+            break
+
+    bst = container.after_training(bst)
+
+    if evals_result is not None:
+        for k, v in container.history.items():
+            evals_result[k] = {mk: list(mv) for mk, mv in v.items()}
+    return bst
+
+
+def _make_folds(
+    dtrain: DMatrix,
+    nfold: int,
+    params: Dict[str, Any],
+    seed: int,
+    stratified: bool,
+    folds,
+    shuffle: bool = True,
+):
+    n = dtrain.num_row()
+    rng = np.random.RandomState(seed)
+    if folds is not None:
+        splits = folds if not hasattr(folds, "split") else list(
+            folds.split(X=np.zeros(n), y=dtrain.get_label())
+        )
+    else:
+        idx = np.arange(n)
+        if shuffle:
+            rng.shuffle(idx)
+        if stratified and dtrain.info.label is not None:
+            label = dtrain.get_label()[idx]
+            order = np.argsort(label, kind="stable")
+            idx = idx[order]  # interleave classes across folds
+            fold_of = np.arange(n) % nfold
+        else:
+            fold_of = np.repeat(np.arange(nfold), int(np.ceil(n / nfold)))[:n]
+        splits = []
+        for k in range(nfold):
+            test = idx[fold_of == k]
+            trainix = idx[fold_of != k]
+            splits.append((trainix, test))
+    out = []
+    for trainix, testix in splits:
+        dtr = dtrain.slice(np.asarray(trainix))
+        dte = dtrain.slice(np.asarray(testix))
+        out.append((dtr, dte))
+    return out
+
+
+def cv(
+    params: Dict[str, Any],
+    dtrain: DMatrix,
+    num_boost_round: int = 10,
+    nfold: int = 3,
+    stratified: bool = False,
+    folds=None,
+    metrics: Sequence[str] = (),
+    obj=None,
+    feval=None,
+    maximize: Optional[bool] = None,
+    early_stopping_rounds: Optional[int] = None,
+    fpreproc=None,
+    as_pandas: bool = True,
+    verbose_eval: Any = None,
+    show_stdv: bool = True,
+    seed: int = 0,
+    callbacks: Optional[Sequence[TrainingCallback]] = None,
+    shuffle: bool = True,
+    custom_metric=None,
+):
+    """K-fold cross-validation (reference training.py:189-459)."""
+    params = dict(params)
+    if isinstance(metrics, str):
+        metrics = [metrics]
+    if metrics:
+        params["eval_metric"] = list(metrics)
+    folds_data = _make_folds(dtrain, nfold, params, seed, stratified, folds, shuffle)
+    cvpacks = []
+    for dtr, dte in folds_data:
+        p = params
+        if fpreproc is not None:
+            dtr, dte, p = fpreproc(dtr, dte, dict(params))
+        cvpacks.append((Booster(p, cache=[dtr, dte]), dtr, dte))
+
+    feval = custom_metric if custom_metric is not None else feval
+    history: Dict[str, List[float]] = {}
+    rounds_done = 0
+    best_iteration = None
+    es_state = {"best": None, "rounds": 0}
+
+    results_per_round: List[Dict[str, Tuple[float, float]]] = []
+    for i in range(num_boost_round):
+        round_scores: Dict[str, List[float]] = {}
+        for bst, dtr, dte in cvpacks:
+            bst.update(dtr, i, fobj=obj)
+            msg = bst.eval_set([(dtr, "train"), (dte, "test")], i, feval=feval)
+            for tok in msg.split("\t")[1:]:
+                nm, _, val = tok.rpartition(":")
+                round_scores.setdefault(nm, []).append(float(val))
+        agg = {k: (float(np.mean(v)), float(np.std(v))) for k, v in round_scores.items()}
+        results_per_round.append(agg)
+        rounds_done = i + 1
+        for k, (m, s) in agg.items():
+            history.setdefault(f"{k}-mean", []).append(m)
+            history.setdefault(f"{k}-std", []).append(s)
+        if verbose_eval:
+            line = f"[{i}]\t" + "\t".join(
+                f"{k}:{m:.5f}" + (f"+{s:.5f}" if show_stdv else "")
+                for k, (m, s) in agg.items()
+            )
+            print(line, flush=True)
+        if early_stopping_rounds is not None:
+            test_keys = [k for k in agg if k.startswith("test-")]
+            if test_keys:
+                key = test_keys[-1]
+                score = agg[key][0]
+                base = key[len("test-"):].split("@")[0]
+                is_max = (
+                    maximize
+                    if maximize is not None
+                    else base in EarlyStopping._MAXIMIZE_METRICS
+                )
+                best = es_state["best"]
+                improved = (
+                    best is None
+                    or (is_max and score > best)
+                    or (not is_max and score < best)
+                )
+                if improved:
+                    es_state["best"] = score
+                    es_state["rounds"] = 0
+                    best_iteration = i
+                else:
+                    es_state["rounds"] += 1
+                    if es_state["rounds"] >= early_stopping_rounds:
+                        break
+    if early_stopping_rounds is not None and best_iteration is not None:
+        for k in history:
+            history[k] = history[k][: best_iteration + 1]
+    if as_pandas:
+        try:
+            import pandas as pd
+
+            return pd.DataFrame(history)
+        except ImportError:
+            pass
+    return history

@@ -1,0 +1,324 @@
+"""tpu_hist: fixed-shape level-wise tree growth as one XLA program.
+
+Reference equivalents: ``grow_quantile_histmaker``
+(``src/tree/updater_quantile_hist.cc``) and ``grow_gpu_hist``
+(``src/tree/updater_gpu_hist.cu``) — histogram build
+(``gpu_hist/histogram.cu:127``), split evaluation
+(``gpu_hist/evaluate_splits.cu:211``), row partition
+(``gpu_hist/row_partitioner.cu``).
+
+TPU-first redesign (SURVEY.md §7): instead of per-node ragged row sets and
+per-level host readbacks (the reference's D2H candidate copies,
+``updater_gpu_hist.cu:352``), the whole tree grows inside a single
+``lax.fori_loop`` over depth with static shapes:
+
+- nodes live in an implicit heap (children of ``i`` at ``2i+1``/``2i+2``);
+- each row carries its current heap position; a level-d histogram is ONE
+  ``segment_sum`` scatter-add over all rows into a padded
+  ``[2^(max_depth-1), F, max_bin+1, 2]`` tensor (missing values land in the
+  dedicated overflow bin — the ELLPACK null-symbol trick);
+- split evaluation is a vmapped cumulative scan over bins with both
+  missing-direction hypotheses evaluated in parallel (the reference's
+  forward/backward enumeration, ``hist/evaluate_splits.h:61``);
+- partition update is a pure gather/compare (no sorting, unlike
+  ``row_partitioner.cuh``).
+
+Because a row belongs to exactly one node per level, histogramming a whole
+level costs one pass over the data regardless of node count — the dense
+analog of the reference's "build smaller sibling + subtract" trick. TPU
+scatter-adds are deterministic, so we get the reproducibility the reference
+needs fixed-point atomics for (``gpu_hist/histogram.cu:81-120``) for free.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .param import RT_EPS, SplitParams, calc_gain, calc_weight
+
+__all__ = ["GrowParams", "HeapTree", "grow_tree", "prune_heap", "leaf_value_map"]
+
+
+@dataclasses.dataclass(frozen=True)
+class GrowParams:
+    """Static hyper-parameters baked into the compiled tree builder."""
+
+    # NOTE: eta deliberately lives OUTSIDE this struct (applied host-side in
+    # RegTree.from_heap / leaf_value_map) so a LearningRateScheduler callback
+    # can change it per-round without forcing an XLA recompile.
+    max_depth: int = 6
+    subsample: float = 1.0
+    colsample_bytree: float = 1.0
+    colsample_bylevel: float = 1.0
+    colsample_bynode: float = 1.0
+    split: SplitParams = SplitParams()
+    # name of a mesh axis to psum histograms over (None = single device).
+    # This is THE distributed hook: the reference's histogram AllReduce
+    # (hist/histogram.h:201, updater_gpu_hist.cu:526) becomes one psum.
+    axis_name: str | None = None
+
+    @property
+    def max_nodes(self) -> int:
+        return (1 << (self.max_depth + 1)) - 1
+
+    @property
+    def level_width(self) -> int:
+        return 1 << max(self.max_depth - 1, 0)
+
+
+class HeapTree(NamedTuple):
+    """Heap-layout tree tensors produced on device."""
+
+    is_split: jax.Array  # bool [max_nodes]
+    feature: jax.Array  # int32 [max_nodes]
+    split_bin: jax.Array  # int32 [max_nodes]
+    split_cond: jax.Array  # f32 [max_nodes]
+    default_left: jax.Array  # bool [max_nodes]
+    node_g: jax.Array  # f32 [max_nodes] sum gradient
+    node_h: jax.Array  # f32 [max_nodes] sum hessian
+    node_weight: jax.Array  # f32 [max_nodes] pre-eta optimal weight
+    loss_chg: jax.Array  # f32 [max_nodes]
+    positions: jax.Array  # int32 [n_rows] final heap position of each row
+
+
+def _sample_features_exact(key: jax.Array, n_features: int, frac: float) -> jax.Array:
+    """Exact-k without-replacement feature subset (reference:
+    ColumnSampler, src/common/random.h:120)."""
+    k = max(1, int(round(frac * n_features)))
+    perm = jax.random.permutation(key, n_features)
+    return jnp.zeros((n_features,), bool).at[perm[:k]].set(True)
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def grow_tree(
+    bins: jax.Array,  # [n, F] narrow int bin ids (missing == max_bin)
+    grad: jax.Array,  # [n] f32
+    hess: jax.Array,  # [n] f32
+    cut_values: jax.Array,  # [F, max_bin] f32
+    key: jax.Array,
+    cfg: GrowParams,
+) -> HeapTree:
+    n, F = bins.shape
+    B = cut_values.shape[1]
+    MB = B + 1  # +1 missing/overflow bin
+    p = cfg.split
+    max_depth = cfg.max_depth
+    Nmax = cfg.level_width
+    max_nodes = cfg.max_nodes
+    bins32 = bins.astype(jnp.int32)
+
+    k_sub, k_ctree, k_level = jax.random.split(key, 3)
+
+    # ---- row subsampling: zero the gradients of dropped rows (reference
+    # hist semantics: unsampled rows keep flowing through partitions but
+    # contribute no statistics) ----
+    if cfg.subsample < 1.0:
+        keep = jax.random.bernoulli(k_sub, cfg.subsample, (n,))
+        grad = jnp.where(keep, grad, 0.0)
+        hess = jnp.where(keep, hess, 0.0)
+
+    # ---- hierarchical column sampling ----
+    if cfg.colsample_bytree < 1.0:
+        tree_mask = _sample_features_exact(k_ctree, F, cfg.colsample_bytree)
+    else:
+        tree_mask = jnp.ones((F,), bool)
+
+    gh = jnp.stack([grad, hess], axis=-1)  # [n, 2]
+
+    def body(d: jax.Array, state):
+        (pos, is_split, feature, split_bin, split_cond, default_left,
+         node_g, node_h, node_w, loss_chg) = state
+
+        offset = (1 << d) - 1  # first heap id of this level
+        width = 1 << d  # real nodes at this level (<= Nmax)
+        local = pos - offset
+        level_active = (local >= 0) & (local < width)
+
+        # ---- histogram: one scatter-add over all (row, feature) pairs ----
+        sid = local[:, None] * (F * MB) + jnp.arange(F, dtype=jnp.int32)[None, :] * MB + bins32
+        sid = jnp.where(level_active[:, None], sid, -1)
+        gh_full = jnp.broadcast_to(gh[:, None, :], (n, F, 2)).reshape(-1, 2)
+        hist = jax.ops.segment_sum(gh_full, sid.reshape(-1), num_segments=Nmax * F * MB)
+        hist = hist.reshape(Nmax, F, MB, 2)
+        if cfg.axis_name is not None:
+            # distributed row-sharded training: the one collective of the
+            # hot loop (cost independent of row count)
+            hist = jax.lax.psum(hist, axis_name=cfg.axis_name)
+
+        # node totals: every row hits exactly one bin of feature 0
+        Gtot = hist[:, 0, :, 0].sum(-1)  # [Nmax]
+        Htot = hist[:, 0, :, 1].sum(-1)
+
+        # ---- split evaluation over [node, direction, feature, bin] ----
+        g_b = hist[:, :, :B, 0]
+        h_b = hist[:, :, :B, 1]
+        g_miss = hist[:, :, B, 0]  # [Nmax, F]
+        h_miss = hist[:, :, B, 1]
+        GL = jnp.cumsum(g_b, axis=-1)
+        HL = jnp.cumsum(h_b, axis=-1)
+        # dir 0: missing goes right (default_left=False); dir 1: missing left
+        GLd = jnp.stack([GL, GL + g_miss[..., None]], axis=1)  # [Nmax, 2, F, B]
+        HLd = jnp.stack([HL, HL + h_miss[..., None]], axis=1)
+        GRd = Gtot[:, None, None, None] - GLd
+        HRd = Htot[:, None, None, None] - HLd
+        gain = calc_gain(GLd, HLd, p) + calc_gain(GRd, HRd, p)
+        parent_gain = calc_gain(Gtot, Htot, p)
+        chg = gain - parent_gain[:, None, None, None]
+
+        valid = (HLd >= p.min_child_weight) & (HRd >= p.min_child_weight)
+        fmask = tree_mask
+        if cfg.colsample_bylevel < 1.0:
+            kl = jax.random.fold_in(k_level, d)
+            fmask = fmask & jax.random.bernoulli(kl, cfg.colsample_bylevel, (F,))
+        if cfg.colsample_bynode < 1.0:
+            kn = jax.random.fold_in(jax.random.fold_in(k_level, d), 1)
+            node_fmask = fmask[None, :] & jax.random.bernoulli(
+                kn, cfg.colsample_bynode, (Nmax, F)
+            )
+        else:
+            node_fmask = jnp.broadcast_to(fmask[None, :], (Nmax, F))
+        valid = valid & node_fmask[:, None, :, None]
+
+        score = jnp.where(valid, chg, -jnp.inf)
+        flat = score.reshape(Nmax, -1)
+        best_idx = jnp.argmax(flat, axis=-1)  # [Nmax]
+        best_loss = jnp.take_along_axis(flat, best_idx[:, None], axis=1)[:, 0]
+        FB = F * B
+        best_dir = best_idx // FB
+        rem = best_idx % FB
+        best_f = (rem // B).astype(jnp.int32)
+        best_b = (rem % B).astype(jnp.int32)
+
+        slot_real = jnp.arange(Nmax) < width
+        can_split = (best_loss > RT_EPS) & (Htot > 0.0) & slot_real
+
+        # best-split child stats (gathered once; become next level's totals)
+        flat4 = lambda a: jnp.take_along_axis(a.reshape(Nmax, -1), best_idx[:, None], axis=1)[:, 0]
+        GLb, HLb = flat4(GLd), flat4(HLd)
+        GRb, HRb = Gtot - GLb, Htot - HLb
+
+        cond = cut_values[best_f, best_b]  # [Nmax]
+
+        # ---- write this level's nodes into the heap arrays ----
+        slots = offset + jnp.arange(Nmax)
+        widx = jnp.where(slot_real, slots, max_nodes)  # OOB -> dropped
+        is_split = is_split.at[widx].set(can_split, mode="drop")
+        feature = feature.at[widx].set(best_f, mode="drop")
+        split_bin = split_bin.at[widx].set(best_b, mode="drop")
+        split_cond = split_cond.at[widx].set(cond, mode="drop")
+        default_left = default_left.at[widx].set(best_dir == 1, mode="drop")
+        node_g = node_g.at[widx].set(Gtot, mode="drop")
+        node_h = node_h.at[widx].set(Htot, mode="drop")
+        node_w = node_w.at[widx].set(calc_weight(Gtot, Htot, p), mode="drop")
+        loss_chg = loss_chg.at[widx].set(jnp.where(can_split, best_loss, 0.0), mode="drop")
+
+        # pre-write children stats/weights — the only way depth-max leaves
+        # (never histogrammed) get their values; inner nodes are refreshed
+        # from their own histogram next iteration
+        cidx = jnp.where(can_split, 2 * slots + 1, max_nodes)
+        node_g = node_g.at[cidx].set(GLb, mode="drop")
+        node_h = node_h.at[cidx].set(HLb, mode="drop")
+        node_w = node_w.at[cidx].set(calc_weight(GLb, HLb, p), mode="drop")
+        cidx = jnp.where(can_split, 2 * slots + 2, max_nodes)
+        node_g = node_g.at[cidx].set(GRb, mode="drop")
+        node_h = node_h.at[cidx].set(HRb, mode="drop")
+        node_w = node_w.at[cidx].set(calc_weight(GRb, HRb, p), mode="drop")
+
+        # ---- partition: route rows of split nodes to their children ----
+        goes = is_split[pos]
+        f_of = feature[pos]
+        b_of = split_bin[pos]
+        dl_of = default_left[pos]
+        bv = jnp.take_along_axis(bins32, f_of[:, None], axis=1)[:, 0]
+        missing = bv == B
+        goleft = jnp.where(missing, dl_of, bv <= b_of)
+        pos = jnp.where(goes, jnp.where(goleft, 2 * pos + 1, 2 * pos + 2), pos)
+
+        return (pos, is_split, feature, split_bin, split_cond, default_left,
+                node_g, node_h, node_w, loss_chg)
+
+    init = (
+        jnp.zeros((n,), jnp.int32),
+        jnp.zeros((max_nodes,), bool),
+        jnp.zeros((max_nodes,), jnp.int32),
+        jnp.zeros((max_nodes,), jnp.int32),
+        jnp.zeros((max_nodes,), jnp.float32),
+        jnp.zeros((max_nodes,), bool),
+        jnp.zeros((max_nodes,), jnp.float32),
+        jnp.zeros((max_nodes,), jnp.float32),
+        jnp.zeros((max_nodes,), jnp.float32),
+        jnp.zeros((max_nodes,), jnp.float32),
+    )
+    if max_depth == 0:
+        state = init
+        # single leaf: weight from global sums
+        G, H = grad.sum(), hess.sum()
+        if cfg.axis_name is not None:
+            G = jax.lax.psum(G, cfg.axis_name)
+            H = jax.lax.psum(H, cfg.axis_name)
+        state = (
+            state[0], state[1], state[2], state[3], state[4], state[5],
+            state[6].at[0].set(G), state[7].at[0].set(H),
+            state[8].at[0].set(calc_weight(G, H, p)), state[9],
+        )
+    else:
+        state = jax.lax.fori_loop(0, max_depth, body, init)
+
+    (pos, is_split, feature, split_bin, split_cond, default_left,
+     node_g, node_h, node_w, loss_chg) = state
+    return HeapTree(
+        is_split=is_split, feature=feature, split_bin=split_bin,
+        split_cond=split_cond, default_left=default_left,
+        node_g=node_g, node_h=node_h, node_weight=node_w,
+        loss_chg=loss_chg, positions=pos,
+    )
+
+
+def prune_heap(is_split: np.ndarray, loss_chg: np.ndarray, min_split_loss: float) -> np.ndarray:
+    """Recursive bottom-up gamma pruning (reference: ``updater_prune.cc`` —
+    chained after every grower; collapses split nodes whose children are
+    leaves and whose loss_chg < gamma)."""
+    out = is_split.copy()
+    if min_split_loss <= 0.0:
+        return out
+    n = len(out)
+    changed = True
+    while changed:
+        changed = False
+        for i in range(n - 1, -1, -1):
+            if not out[i]:
+                continue
+            l, r = 2 * i + 1, 2 * i + 2
+            l_leaf = l >= n or not out[l]
+            r_leaf = r >= n or not out[r]
+            if l_leaf and r_leaf and loss_chg[i] < min_split_loss:
+                out[i] = False
+                changed = True
+    return out
+
+
+def leaf_value_map(
+    pruned_is_split: np.ndarray, weight: np.ndarray, eta: float
+) -> np.ndarray:
+    """Map every heap node to the leaf value governing it in the (pruned)
+    tree, so the prediction cache can be updated with one gather on the
+    rows' final positions (reference: UpdatePredictionCache fast path,
+    ``gbtree.cc:219`` / ``updater_quantile_hist.cc``)."""
+    n = len(pruned_is_split)
+    vals = np.full(n, np.nan, np.float32)
+    if not pruned_is_split[0]:
+        vals[:] = eta * weight[0]
+        return vals
+    for h in range(1, n):
+        parent = (h - 1) // 2
+        if not np.isnan(vals[parent]):
+            vals[h] = vals[parent]  # below a leaf: inherit
+        elif not pruned_is_split[h]:
+            vals[h] = eta * weight[h]  # this node is a leaf
+    return vals

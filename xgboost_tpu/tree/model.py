@@ -1,0 +1,256 @@
+"""RegTree: struct-of-arrays decision tree model.
+
+The reference's ``RegTree`` (``include/xgboost/tree_model.h:131``) stores an
+array of Node structs; its JSON model format
+(``src/tree/tree_model.cc:898-911``, schema ``doc/model.schema``) is already
+struct-of-arrays — ``left_children / right_children / parents /
+split_indices / split_conditions / default_left / base_weights /
+loss_changes / sum_hessian``. SoA is the accelerator-native layout, so we
+adopt it directly as the in-memory representation (host numpy; stacked into
+padded device tensors by the predictor).
+
+Node conventions (same as reference):
+- node 0 is the root; leaves have ``left_children[i] == -1``
+- for leaves, ``split_conditions[i]`` holds the leaf value (post learning
+  rate), as in the reference JSON format
+- decision: missing -> default child; else ``fvalue < split_condition`` goes
+  left.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["RegTree"]
+
+
+@dataclasses.dataclass
+class RegTree:
+    left_children: np.ndarray  # int32 [n]
+    right_children: np.ndarray  # int32 [n]
+    parents: np.ndarray  # int32 [n]
+    split_indices: np.ndarray  # int32 [n]
+    split_conditions: np.ndarray  # float32 [n] (leaf value for leaves)
+    default_left: np.ndarray  # bool [n]
+    base_weights: np.ndarray  # float32 [n]
+    loss_changes: np.ndarray  # float32 [n]
+    sum_hessian: np.ndarray  # float32 [n]
+    # categorical split support (reference: split_categories bitsets,
+    # tree_model.h:442 ExpandCategorical). split_type: 0=numerical 1=categorical
+    split_type: Optional[np.ndarray] = None  # int8 [n]
+    categories: Optional[List[np.ndarray]] = None  # per-node sorted category ids
+
+    @property
+    def num_nodes(self) -> int:
+        return int(self.left_children.shape[0])
+
+    def is_leaf(self, i: int) -> bool:
+        return self.left_children[i] == -1
+
+    @property
+    def num_leaves(self) -> int:
+        return int(np.count_nonzero(self.left_children == -1))
+
+    def max_depth(self) -> int:
+        depth = np.zeros(self.num_nodes, dtype=np.int32)
+        for i in range(1, self.num_nodes):
+            depth[i] = depth[self.parents[i]] + 1
+        return int(depth.max(initial=0))
+
+    @classmethod
+    def single_leaf(cls, value: float) -> "RegTree":
+        return cls(
+            left_children=np.array([-1], np.int32),
+            right_children=np.array([-1], np.int32),
+            parents=np.array([-1], np.int32),
+            split_indices=np.array([0], np.int32),
+            split_conditions=np.array([value], np.float32),
+            default_left=np.array([False]),
+            base_weights=np.array([value], np.float32),
+            loss_changes=np.array([0.0], np.float32),
+            sum_hessian=np.array([0.0], np.float32),
+        )
+
+    # ------------------------------------------------------------------
+    # construction from the grower's heap-layout arrays
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_heap(
+        cls,
+        is_split: np.ndarray,  # bool [max_heap_nodes]
+        feature: np.ndarray,
+        split_cond: np.ndarray,
+        default_left: np.ndarray,
+        weight: np.ndarray,  # pre-eta leaf weight per heap node
+        loss_chg: np.ndarray,
+        sum_hess: np.ndarray,
+        eta: float,
+    ) -> "RegTree":
+        """Compact a heap-layout tree (children of heap node i at 2i+1/2i+2)
+        into BFS-ordered SoA. ``is_split`` must already be gamma-pruned
+        (see ``grow.prune_heap``, the analog of the reference's chained
+        ``updater_prune.cc``)."""
+        n_heap = len(is_split)
+
+        # BFS over existing heap nodes
+        heap_ids: List[int] = [0]
+        compact_of: Dict[int, int] = {0: 0}
+        order: List[int] = []
+        while heap_ids:
+            h = heap_ids.pop(0)
+            order.append(h)
+            if is_split[h]:
+                for c in (2 * h + 1, 2 * h + 2):
+                    compact_of[c] = -2  # placeholder; assigned below
+                    heap_ids.append(c)
+        for idx, h in enumerate(order):
+            compact_of[h] = idx
+
+        n = len(order)
+        lc = np.full(n, -1, np.int32)
+        rc = np.full(n, -1, np.int32)
+        par = np.full(n, -1, np.int32)
+        sidx = np.zeros(n, np.int32)
+        scond = np.zeros(n, np.float32)
+        dleft = np.zeros(n, bool)
+        bw = np.zeros(n, np.float32)
+        lchg = np.zeros(n, np.float32)
+        shess = np.zeros(n, np.float32)
+        for idx, h in enumerate(order):
+            bw[idx] = eta * weight[h]
+            shess[idx] = sum_hess[h]
+            if h > 0:
+                par[idx] = compact_of[(h - 1) // 2]
+            if is_split[h]:
+                lc[idx] = compact_of[2 * h + 1]
+                rc[idx] = compact_of[2 * h + 2]
+                sidx[idx] = feature[h]
+                scond[idx] = split_cond[h]
+                dleft[idx] = bool(default_left[h])
+                lchg[idx] = loss_chg[h]
+            else:
+                scond[idx] = eta * weight[h]  # leaf value
+        return cls(
+            left_children=lc,
+            right_children=rc,
+            parents=par,
+            split_indices=sidx,
+            split_conditions=scond,
+            default_left=dleft,
+            base_weights=bw,
+            loss_changes=lchg,
+            sum_hessian=shess,
+        )
+
+    # ------------------------------------------------------------------
+    # XGBoost-compatible JSON (doc/model.schema layout)
+    # ------------------------------------------------------------------
+    def to_json(self, tree_id: int = 0) -> dict:
+        n = self.num_nodes
+        return {
+            "tree_param": {
+                "num_nodes": str(n),
+                "num_feature": str(int(self.split_indices.max(initial=0)) + 1),
+                "num_deleted": "0",
+                "size_leaf_vector": "0",
+            },
+            "id": tree_id,
+            "left_children": self.left_children.tolist(),
+            "right_children": self.right_children.tolist(),
+            "parents": self.parents.tolist(),
+            "split_indices": self.split_indices.tolist(),
+            "split_conditions": [float(x) for x in self.split_conditions],
+            "default_left": [int(x) for x in self.default_left],
+            "split_type": (
+                [int(x) for x in self.split_type]
+                if self.split_type is not None
+                else [0] * n
+            ),
+            "categories": [],
+            "categories_nodes": [],
+            "categories_segments": [],
+            "categories_sizes": [],
+            "base_weights": [float(x) for x in self.base_weights],
+            "loss_changes": [float(x) for x in self.loss_changes],
+            "sum_hessian": [float(x) for x in self.sum_hessian],
+        }
+
+    @classmethod
+    def from_json(cls, j: dict) -> "RegTree":
+        n = len(j["left_children"])
+        st = np.asarray(j.get("split_type", [0] * n), np.int8)
+        return cls(
+            left_children=np.asarray(j["left_children"], np.int32),
+            right_children=np.asarray(j["right_children"], np.int32),
+            parents=np.asarray(j["parents"], np.int32),
+            split_indices=np.asarray(j["split_indices"], np.int32),
+            split_conditions=np.asarray(j["split_conditions"], np.float32),
+            default_left=np.asarray(j["default_left"], bool),
+            base_weights=np.asarray(j.get("base_weights", [0.0] * n), np.float32),
+            loss_changes=np.asarray(j.get("loss_changes", [0.0] * n), np.float32),
+            sum_hessian=np.asarray(j.get("sum_hessian", [0.0] * n), np.float32),
+            split_type=st,
+        )
+
+    # ------------------------------------------------------------------
+    # host reference predict (oracle for the XLA predictor) + dumps
+    # ------------------------------------------------------------------
+    def predict_one(self, x: np.ndarray) -> float:
+        i = 0
+        while self.left_children[i] != -1:
+            f = self.split_indices[i]
+            v = x[f]
+            if np.isnan(v):
+                i = self.left_children[i] if self.default_left[i] else self.right_children[i]
+            elif v < self.split_conditions[i]:
+                i = self.left_children[i]
+            else:
+                i = self.right_children[i]
+        return float(self.split_conditions[i])
+
+    def leaf_of(self, x: np.ndarray) -> int:
+        i = 0
+        while self.left_children[i] != -1:
+            f = self.split_indices[i]
+            v = x[f]
+            if np.isnan(v):
+                i = self.left_children[i] if self.default_left[i] else self.right_children[i]
+            elif v < self.split_conditions[i]:
+                i = self.left_children[i]
+            else:
+                i = self.right_children[i]
+        return i
+
+    def dump_text(self, fmap: Optional[List[str]] = None, with_stats: bool = False) -> str:
+        lines: List[str] = []
+
+        def rec(i: int, depth: int) -> None:
+            indent = "\t" * depth
+            if self.is_leaf(i):
+                s = f"{indent}{i}:leaf={self.split_conditions[i]:.6g}"
+                if with_stats:
+                    s += f",cover={self.sum_hessian[i]:.6g}"
+                lines.append(s)
+            else:
+                fname = (
+                    fmap[self.split_indices[i]]
+                    if fmap
+                    else f"f{self.split_indices[i]}"
+                )
+                yes, no = self.left_children[i], self.right_children[i]
+                miss = yes if self.default_left[i] else no
+                s = (
+                    f"{indent}{i}:[{fname}<{self.split_conditions[i]:.6g}] "
+                    f"yes={yes},no={no},missing={miss}"
+                )
+                if with_stats:
+                    s += f",gain={self.loss_changes[i]:.6g},cover={self.sum_hessian[i]:.6g}"
+                lines.append(s)
+                rec(yes, depth + 1)
+                rec(no, depth + 1)
+
+        rec(0, 0)
+        return "\n".join(lines)

@@ -1,0 +1,2 @@
+from .log import Logger, console_logger  # noqa: F401
+from .timer import Monitor  # noqa: F401

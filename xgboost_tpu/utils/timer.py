@@ -1,0 +1,53 @@
+"""Per-label accumulating timers.
+
+Analog of ``common::Monitor`` (``src/common/timer.h:16,47``): label ->
+accumulated wall time + call count per component, printed at verbosity>=3.
+On TPU the heavyweight profiling story is ``jax.profiler``; this is the
+cheap always-on host-side accumulator the reference keeps around every
+phase (learner.cc:1061-1085).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, Iterator, Tuple
+
+from ..config import get_config
+
+
+class Monitor:
+    def __init__(self, label: str):
+        self.label = label
+        self.stats: Dict[str, Tuple[float, int]] = {}
+        self._open: Dict[str, float] = {}
+
+    def start(self, name: str) -> None:
+        self._open[name] = time.perf_counter()
+
+    def stop(self, name: str) -> None:
+        t0 = self._open.pop(name, None)
+        if t0 is None:
+            return
+        acc, n = self.stats.get(name, (0.0, 0))
+        self.stats[name] = (acc + time.perf_counter() - t0, n + 1)
+
+    @contextlib.contextmanager
+    def section(self, name: str) -> Iterator[None]:
+        self.start(name)
+        try:
+            yield
+        finally:
+            self.stop(name)
+
+    def report(self) -> str:
+        lines = [f"======== Monitor: {self.label} ========"]
+        for name, (acc, n) in sorted(self.stats.items()):
+            lines.append(f"{name}: {acc * 1e3:.3f}ms, {n} calls")
+        return "\n".join(lines)
+
+    def maybe_print(self) -> None:
+        if get_config()["verbosity"] >= 3 and self.stats:
+            import sys
+
+            print(self.report(), file=sys.stderr, flush=True)
