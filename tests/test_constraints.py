@@ -1,0 +1,92 @@
+"""Monotone + interaction constraint tests (reference analog:
+tests/python/test_monotone_constraints.py, test_interaction_constraints.py)."""
+
+import numpy as np
+import pytest
+
+import xgboost_tpu as xgb
+
+
+def _is_monotone(bst, feature: int, increasing: bool, f_count: int) -> bool:
+    """Probe predictions along one feature with the rest fixed."""
+    grid = np.linspace(-2, 2, 50, dtype=np.float32)
+    X = np.zeros((50, f_count), np.float32)
+    X[:, feature] = grid
+    p = bst.predict(xgb.DMatrix(X), output_margin=True)
+    d = np.diff(p)
+    return bool(np.all(d >= -1e-5)) if increasing else bool(np.all(d <= 1e-5))
+
+
+def test_monotone_increasing_and_decreasing():
+    rng = np.random.RandomState(0)
+    X = rng.uniform(-2, 2, size=(4000, 2)).astype(np.float32)
+    # noisy target with genuine positive trend on f0, negative on f1
+    y = 2 * X[:, 0] - 3 * X[:, 1] + np.sin(4 * X[:, 0]) + rng.randn(4000)
+    d = xgb.DMatrix(X, label=y.astype(np.float32))
+    bst = xgb.train(
+        {"objective": "reg:squarederror", "max_depth": 4,
+         "monotone_constraints": "(1,-1)", "eta": 0.3},
+        d, num_boost_round=15, verbose_eval=False,
+    )
+    assert _is_monotone(bst, 0, increasing=True, f_count=2)
+    assert _is_monotone(bst, 1, increasing=False, f_count=2)
+
+
+def test_unconstrained_violates_monotonicity():
+    # sanity: without constraints the sin() wiggle should break monotonicity
+    rng = np.random.RandomState(0)
+    X = rng.uniform(-2, 2, size=(4000, 2)).astype(np.float32)
+    y = X[:, 0] + 2.0 * np.sin(4 * X[:, 0]) + 0.1 * rng.randn(4000)
+    d = xgb.DMatrix(X, label=y.astype(np.float32))
+    bst = xgb.train({"objective": "reg:squarederror", "max_depth": 5},
+                    d, num_boost_round=15, verbose_eval=False)
+    assert not _is_monotone(bst, 0, increasing=True, f_count=2)
+
+
+def _tree_paths(tree):
+    """Sets of features used along each root->leaf path."""
+    paths = []
+
+    def rec(i, feats):
+        if tree.left_children[i] == -1:
+            paths.append(frozenset(feats))
+            return
+        f = int(tree.split_indices[i])
+        rec(tree.left_children[i], feats | {f})
+        rec(tree.right_children[i], feats | {f})
+
+    rec(0, set())
+    return paths
+
+
+def test_interaction_constraints_respected():
+    rng = np.random.RandomState(1)
+    X = rng.randn(3000, 4).astype(np.float32)
+    y = (X[:, 0] * X[:, 1] + X[:, 2] * X[:, 3] + 0.1 * rng.randn(3000)).astype(np.float32)
+    d = xgb.DMatrix(X, label=y)
+    bst = xgb.train(
+        {"objective": "reg:squarederror", "max_depth": 4,
+         "interaction_constraints": [[0, 1], [2, 3]]},
+        d, num_boost_round=10, verbose_eval=False,
+    )
+    allowed = [frozenset({0, 1}), frozenset({2, 3})]
+    for t in bst._gbm.model.trees:
+        for path in _tree_paths(t):
+            if len(path) <= 1:
+                continue
+            assert any(path <= a for a in allowed), f"path {set(path)} crosses groups"
+
+
+def test_interaction_constraints_unconstrained_mixes():
+    rng = np.random.RandomState(1)
+    X = rng.randn(3000, 4).astype(np.float32)
+    y = (X[:, 0] * X[:, 2] + 0.1 * rng.randn(3000)).astype(np.float32)
+    d = xgb.DMatrix(X, label=y)
+    bst = xgb.train({"objective": "reg:squarederror", "max_depth": 4},
+                    d, num_boost_round=10, verbose_eval=False)
+    mixed = any(
+        len(path) > 1 and not (path <= {0, 1} or path <= {2, 3})
+        for t in bst._gbm.model.trees
+        for path in _tree_paths(t)
+    )
+    assert mixed

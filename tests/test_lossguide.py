@@ -1,0 +1,113 @@
+"""Lossguide grow-policy tests (reference analog: driver.h lossguide path,
+tests/python test_updaters grow_policy cases)."""
+
+import numpy as np
+import pytest
+
+import xgboost_tpu as xgb
+
+
+def _data(n=2000, f=6, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f).astype(np.float32)
+    y = (X[:, 0] * 2 - X[:, 1] + 0.3 * rng.randn(n) > 0).astype(np.float32)
+    return X, y
+
+
+def test_lossguide_trains_and_caps_leaves():
+    X, y = _data()
+    d = xgb.DMatrix(X, label=y)
+    res = {}
+    bst = xgb.train(
+        {"objective": "binary:logistic", "grow_policy": "lossguide",
+         "max_leaves": 8, "max_depth": 0, "eval_metric": "logloss"},
+        d, num_boost_round=10, evals=[(d, "train")], evals_result=res,
+        verbose_eval=False,
+    )
+    assert res["train"]["logloss"][-1] < res["train"]["logloss"][0]
+    for t in bst._gbm.model.trees:
+        assert t.num_leaves <= 8
+
+
+def test_lossguide_respects_max_depth():
+    X, y = _data()
+    d = xgb.DMatrix(X, label=y)
+    bst = xgb.train(
+        {"objective": "binary:logistic", "grow_policy": "lossguide",
+         "max_leaves": 32, "max_depth": 3},
+        d, num_boost_round=3, verbose_eval=False,
+    )
+    for t in bst._gbm.model.trees:
+        assert t.max_depth() <= 3
+
+
+def test_lossguide_cache_matches_predict():
+    X, y = _data(800, 5)
+    d = xgb.DMatrix(X, label=y)
+    bst = xgb.train(
+        {"objective": "binary:logistic", "grow_policy": "lossguide", "max_leaves": 16},
+        d, num_boost_round=4, verbose_eval=False,
+    )
+    cached = np.asarray(bst._caches[id(d)].margin)[:, 0]
+    fresh = bst.predict(xgb.DMatrix(X, label=y), output_margin=True)
+    np.testing.assert_allclose(cached, fresh, rtol=1e-4, atol=1e-5)
+
+
+def test_lossguide_honors_monotone_constraints():
+    rng = np.random.RandomState(4)
+    X = rng.uniform(-2, 2, size=(3000, 2)).astype(np.float32)
+    y = (2 * X[:, 0] + np.sin(5 * X[:, 0]) - X[:, 1] + 0.3 * rng.randn(3000)).astype(np.float32)
+    d = xgb.DMatrix(X, label=y)
+    bst = xgb.train(
+        {"objective": "reg:squarederror", "grow_policy": "lossguide",
+         "max_leaves": 16, "monotone_constraints": "(1,0)"},
+        d, num_boost_round=10, verbose_eval=False,
+    )
+    grid = np.zeros((60, 2), np.float32)
+    grid[:, 0] = np.linspace(-2, 2, 60)
+    p = bst.predict(xgb.DMatrix(grid), output_margin=True)
+    assert np.all(np.diff(p) >= -1e-5)
+
+
+def test_lossguide_honors_interaction_constraints():
+    rng = np.random.RandomState(5)
+    X = rng.randn(2000, 4).astype(np.float32)
+    y = (X[:, 0] * X[:, 1] + X[:, 2] * X[:, 3]).astype(np.float32)
+    d = xgb.DMatrix(X, label=y)
+    bst = xgb.train(
+        {"objective": "reg:squarederror", "grow_policy": "lossguide",
+         "max_leaves": 8, "interaction_constraints": [[0, 1], [2, 3]]},
+        d, num_boost_round=5, verbose_eval=False,
+    )
+    allowed = [frozenset({0, 1}), frozenset({2, 3})]
+    for t in bst._gbm.model.trees:
+        paths = []
+
+        def rec(i, feats):
+            if t.left_children[i] == -1:
+                paths.append(frozenset(feats))
+                return
+            rec(t.left_children[i], feats | {int(t.split_indices[i])})
+            rec(t.right_children[i], feats | {int(t.split_indices[i])})
+
+        rec(0, set())
+        for path in paths:
+            if len(path) > 1:
+                assert any(path <= a for a in allowed)
+
+
+def test_lossguide_beats_shallow_depthwise_on_imbalanced_structure():
+    # a target whose structure lives in one corner of feature space:
+    # best-first growth should reach it with few leaves
+    rng = np.random.RandomState(2)
+    X = rng.uniform(0, 1, size=(4000, 2)).astype(np.float32)
+    y = ((X[:, 0] > 0.9) & (X[:, 1] > 0.9)).astype(np.float32)
+    d = xgb.DMatrix(X, label=y)
+    bst = xgb.train(
+        {"objective": "binary:logistic", "grow_policy": "lossguide",
+         "max_leaves": 16, "eta": 1.0},
+        d, num_boost_round=5, verbose_eval=False,
+    )
+    pred = bst.predict(d)
+    acc = ((pred > 0.5) == y).mean()
+    assert acc > 0.99

@@ -101,6 +101,10 @@ class GBTree:
                 min_child_weight=tp.min_child_weight,
                 min_split_loss=tp.gamma,
             ),
+            monotone=tuple(int(c) for c in tp.monotone_constraints),
+            interaction=tuple(
+                tuple(int(f) for f in grp) for grp in tp.interaction_constraints
+            ),
             axis_name=axis_name,
         )
 
@@ -125,6 +129,18 @@ class GBTree:
         cfg = self._grow_params()
         cuts = binned.cuts
         cut_vals = jnp.asarray(cuts.values)
+        lossguide = tp.grow_policy == "lossguide"
+        if lossguide:
+            # default leaf budget: bounded by depth when small, else a fixed
+            # 255 cap — the fixed-shape grower sizes its tensors and loop
+            # trips by this, so it must stay modest (users wanting more set
+            # max_leaves explicitly, as the reference requires for lossguide)
+            if tp.max_leaves:
+                max_leaves = tp.max_leaves
+            elif 0 < tp.max_depth <= 8:
+                max_leaves = 1 << tp.max_depth
+            else:
+                max_leaves = 255
         new_trees: List[RegTree] = []
         for k in range(self.n_groups):
             g = grad[:, k] if grad.ndim == 2 else grad
@@ -133,25 +149,41 @@ class GBTree:
                 key = jax.random.PRNGKey(
                     (tp.seed * 1000003 + iteration * 131 + k * 17 + ptree) & 0x7FFFFFFF
                 )
-                heap = grow_tree(binned.bins, g, h, cut_vals, key, cfg)
-                is_split = np.asarray(heap.is_split)
-                loss_chg = np.asarray(heap.loss_chg)
-                pruned = prune_heap(is_split, loss_chg, tp.gamma)
-                tree = RegTree.from_heap(
-                    pruned,
-                    np.asarray(heap.feature),
-                    np.asarray(heap.split_cond),
-                    np.asarray(heap.default_left),
-                    np.asarray(heap.node_weight),
-                    loss_chg,
-                    np.asarray(heap.node_h),
-                    eta=tp.eta,
-                )
+                if lossguide:
+                    from ..tree.grow_lossguide import grow_tree_lossguide
+
+                    alloc = grow_tree_lossguide(
+                        binned.bins, g, h, cut_vals, key, cfg, max_leaves
+                    )
+                    tree, lmap_np = RegTree.from_alloc(
+                        np.asarray(alloc.left), np.asarray(alloc.right),
+                        np.asarray(alloc.feature), np.asarray(alloc.split_cond),
+                        np.asarray(alloc.default_left), np.asarray(alloc.node_weight),
+                        np.asarray(alloc.loss_chg), np.asarray(alloc.node_h),
+                        int(alloc.n_nodes), eta=tp.eta, min_split_loss=tp.gamma,
+                    )
+                    positions = alloc.positions
+                else:
+                    heap = grow_tree(binned.bins, g, h, cut_vals, key, cfg)
+                    is_split = np.asarray(heap.is_split)
+                    loss_chg = np.asarray(heap.loss_chg)
+                    pruned = prune_heap(is_split, loss_chg, tp.gamma)
+                    tree = RegTree.from_heap(
+                        pruned,
+                        np.asarray(heap.feature),
+                        np.asarray(heap.split_cond),
+                        np.asarray(heap.default_left),
+                        np.asarray(heap.node_weight),
+                        loss_chg,
+                        np.asarray(heap.node_h),
+                        eta=tp.eta,
+                    )
+                    lmap_np = leaf_value_map(pruned, np.asarray(heap.node_weight), tp.eta)
+                    positions = heap.positions
                 self.model.add(tree, k)
                 new_trees.append(tree)
                 if margin_cache is not None:
-                    lmap = jnp.asarray(leaf_value_map(pruned, np.asarray(heap.node_weight), tp.eta))
-                    delta = lmap[heap.positions]
+                    delta = jnp.asarray(lmap_np)[positions]
                     if margin_cache.ndim == 2:
                         margin_cache = margin_cache.at[:, k].add(delta)
                     else:

@@ -36,15 +36,9 @@ def distributed_grow_tree(
     (bitwise identical on every device — the property the reference asserts
     with gpu_hist's debug_synchronize, updater_gpu_hist.cu:49); row
     positions stay sharded."""
-    cfg_dist = GrowParams(
-        max_depth=cfg.max_depth,
-        subsample=cfg.subsample,
-        colsample_bytree=cfg.colsample_bytree,
-        colsample_bylevel=cfg.colsample_bylevel,
-        colsample_bynode=cfg.colsample_bynode,
-        split=cfg.split,
-        axis_name=ROW_AXIS,
-    )
+    import dataclasses
+
+    cfg_dist = dataclasses.replace(cfg, axis_name=ROW_AXIS)
 
     fn = jax.shard_map(
         partial(grow_tree, cfg=cfg_dist),

@@ -5,7 +5,8 @@ Reference equivalents: ``grow_quantile_histmaker``
 (``src/tree/updater_gpu_hist.cu``) — histogram build
 (``gpu_hist/histogram.cu:127``), split evaluation
 (``gpu_hist/evaluate_splits.cu:211``), row partition
-(``gpu_hist/row_partitioner.cu``).
+(``gpu_hist/row_partitioner.cu``), monotone/interaction constraints
+(``src/tree/split_evaluator.h``, ``src/tree/constraints.cc``).
 
 TPU-first redesign (SURVEY.md §7): instead of per-node ragged row sets and
 per-level host readbacks (the reference's D2H candidate copies,
@@ -28,21 +29,35 @@ level costs one pass over the data regardless of node count — the dense
 analog of the reference's "build smaller sibling + subtract" trick. TPU
 scatter-adds are deterministic, so we get the reproducibility the reference
 needs fixed-point atomics for (``gpu_hist/histogram.cu:81-120``) for free.
+
+Monotone constraints follow the reference's bound-propagation design
+(split_evaluator.h): every node carries a [lower, upper] weight interval;
+candidate child weights are clamped into it, sign-violating candidates are
+masked, and the winning split tightens the children's intervals around the
+midpoint. Interaction constraints track the path's used-feature bitmask per
+node and allow a feature iff it is on the path or in a constraint group
+containing the whole path (constraints.cc:58-103 SplitImpl semantics).
 """
 
 from __future__ import annotations
 
 import dataclasses
 from functools import partial
-from typing import NamedTuple, Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .param import RT_EPS, SplitParams, calc_gain, calc_weight
+from .param import RT_EPS, SplitParams, calc_gain, calc_gain_given_weight, calc_weight
 
-__all__ = ["GrowParams", "HeapTree", "grow_tree", "prune_heap", "leaf_value_map"]
+__all__ = [
+    "GrowParams", "HeapTree", "SplitDecision", "grow_tree", "prune_heap",
+    "leaf_value_map", "eval_splits", "child_bounds_and_weights",
+    "interaction_allowed",
+]
+
+_INF = jnp.float32(np.inf)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -58,10 +73,14 @@ class GrowParams:
     colsample_bylevel: float = 1.0
     colsample_bynode: float = 1.0
     split: SplitParams = SplitParams()
+    # per-feature -1/0/+1 monotone directions (empty = unconstrained)
+    monotone: Tuple[int, ...] = ()
+    # interaction groups as tuples of feature ids (empty = unconstrained)
+    interaction: Tuple[Tuple[int, ...], ...] = ()
     # name of a mesh axis to psum histograms over (None = single device).
     # This is THE distributed hook: the reference's histogram AllReduce
     # (hist/histogram.h:201, updater_gpu_hist.cu:526) becomes one psum.
-    axis_name: str | None = None
+    axis_name: Optional[str] = None
 
     @property
     def max_nodes(self) -> int:
@@ -70,6 +89,14 @@ class GrowParams:
     @property
     def level_width(self) -> int:
         return 1 << max(self.max_depth - 1, 0)
+
+    @property
+    def has_monotone(self) -> bool:
+        return any(c != 0 for c in self.monotone)
+
+    @property
+    def has_interaction(self) -> bool:
+        return len(self.interaction) > 0
 
 
 class HeapTree(NamedTuple):
@@ -93,6 +120,113 @@ def _sample_features_exact(key: jax.Array, n_features: int, frac: float) -> jax.
     k = max(1, int(round(frac * n_features)))
     perm = jax.random.permutation(key, n_features)
     return jnp.zeros((n_features,), bool).at[perm[:k]].set(True)
+
+
+class SplitDecision(NamedTuple):
+    """Best split per node row (all [K])."""
+
+    loss: jax.Array  # loss_chg of the winner (-inf if none valid)
+    dir: jax.Array  # 1 = missing goes left
+    f: jax.Array
+    b: jax.Array
+    GL: jax.Array  # left-child stats of the winner (missing included per dir)
+    HL: jax.Array
+    w_node: jax.Array  # (bound-clamped) node weight
+
+
+def eval_splits(
+    hist: jax.Array,  # [K, F, MB, 2]
+    Gtot: jax.Array,  # [K]
+    Htot: jax.Array,
+    p: SplitParams,
+    node_fmask: jax.Array,  # [K, F] allowed features per node
+    B: int,
+    mono: Optional[jax.Array] = None,  # [F] -1/0/+1
+    node_lo: Optional[jax.Array] = None,  # [K] weight bounds
+    node_up: Optional[jax.Array] = None,
+) -> SplitDecision:
+    """The ONE split evaluator (used by both depthwise and lossguide growers
+    — the reference keeps a single HistEvaluator for the same reason,
+    hist/evaluate_splits.h:26). Scans cumulative G/H over bins for both
+    missing-direction hypotheses, applies min_child_weight / feature masks /
+    monotone bound clamping, and argmaxes loss_chg per node."""
+    K, F = hist.shape[0], hist.shape[1]
+    g_b, h_b = hist[:, :, :B, 0], hist[:, :, :B, 1]
+    g_miss, h_miss = hist[:, :, B, 0], hist[:, :, B, 1]
+    GL = jnp.cumsum(g_b, axis=-1)
+    HL = jnp.cumsum(h_b, axis=-1)
+    # dir 0: missing goes right (default_left=False); dir 1: missing left
+    GLd = jnp.stack([GL, GL + g_miss[..., None]], axis=1)  # [K, 2, F, B]
+    HLd = jnp.stack([HL, HL + h_miss[..., None]], axis=1)
+    GRd = Gtot[:, None, None, None] - GLd
+    HRd = Htot[:, None, None, None] - HLd
+
+    if mono is not None:
+        blo = node_lo[:, None, None, None]
+        bup = node_up[:, None, None, None]
+        wl = jnp.clip(calc_weight(GLd, HLd, p), blo, bup)
+        wr = jnp.clip(calc_weight(GRd, HRd, p), blo, bup)
+        gain = calc_gain_given_weight(GLd, HLd, wl, p) + calc_gain_given_weight(GRd, HRd, wr, p)
+        w_node = jnp.clip(calc_weight(Gtot, Htot, p), node_lo, node_up)
+        parent_gain = calc_gain_given_weight(Gtot, Htot, w_node, p)
+        c = mono[None, None, :, None]
+        mono_ok = ~(((c > 0) & (wl > wr)) | ((c < 0) & (wl < wr)))
+    else:
+        gain = calc_gain(GLd, HLd, p) + calc_gain(GRd, HRd, p)
+        w_node = calc_weight(Gtot, Htot, p)
+        parent_gain = calc_gain(Gtot, Htot, p)
+    chg = gain - parent_gain[:, None, None, None]
+
+    valid = (HLd >= p.min_child_weight) & (HRd >= p.min_child_weight)
+    if mono is not None:
+        valid = valid & mono_ok
+    valid = valid & node_fmask[:, None, :, None]
+
+    score = jnp.where(valid, chg, -jnp.inf)
+    flat = score.reshape(K, -1)
+    best_idx = jnp.argmax(flat, axis=-1)
+    best_loss = jnp.take_along_axis(flat, best_idx[:, None], axis=1)[:, 0]
+    FB = F * B
+    pick = lambda a: jnp.take_along_axis(a.reshape(K, -1), best_idx[:, None], axis=1)[:, 0]
+    return SplitDecision(
+        loss=best_loss,
+        dir=(best_idx // FB).astype(jnp.int32),
+        f=((best_idx % FB) // B).astype(jnp.int32),
+        b=((best_idx % FB) % B).astype(jnp.int32),
+        GL=pick(GLd),
+        HL=pick(HLd),
+        w_node=w_node,
+    )
+
+
+def child_bounds_and_weights(
+    p: SplitParams,
+    mono_f: jax.Array,  # [K] constraint sign of the winning feature
+    GLb, HLb, GRb, HRb,
+    node_lo, node_up,  # [K]
+):
+    """Monotone bound propagation for the two children (split_evaluator.h):
+    tighten around the midpoint of the clamped child weights."""
+    wl_b = jnp.clip(calc_weight(GLb, HLb, p), node_lo, node_up)
+    wr_b = jnp.clip(calc_weight(GRb, HRb, p), node_lo, node_up)
+    mid = 0.5 * (wl_b + wr_b)
+    l_lo = jnp.where(mono_f < 0, jnp.maximum(node_lo, mid), node_lo)
+    l_up = jnp.where(mono_f > 0, jnp.minimum(node_up, mid), node_up)
+    r_lo = jnp.where(mono_f > 0, jnp.maximum(node_lo, mid), node_lo)
+    r_up = jnp.where(mono_f < 0, jnp.minimum(node_up, mid), node_up)
+    wl_c = jnp.clip(wl_b, l_lo, l_up)
+    wr_c = jnp.clip(wr_b, r_lo, r_up)
+    return l_lo, l_up, r_lo, r_up, wl_c, wr_c
+
+
+def interaction_allowed(used: jax.Array, gmask: jax.Array) -> jax.Array:
+    """[K, F] allowed mask from per-node used-feature bitmasks and [G, F]
+    group masks (constraints.cc:58 SplitImpl semantics: allowed = path
+    features ∪ groups containing the whole path; all features at the root)."""
+    any_used = used.any(axis=1, keepdims=True)
+    relevant = ~jnp.any(used[:, None, :] & ~gmask[None, :, :], axis=-1)  # [K, G]
+    from_groups = jnp.any(relevant[:, :, None] & gmask[None, :, :], axis=1)
+    return jnp.where(any_used, used | from_groups, jnp.ones_like(used))
 
 
 @partial(jax.jit, static_argnames=("cfg",))
@@ -129,11 +263,24 @@ def grow_tree(
     else:
         tree_mask = jnp.ones((F,), bool)
 
+    # ---- constraint constants ----
+    if cfg.has_monotone:
+        mono = np.zeros(F, np.int32)
+        mono[: len(cfg.monotone)] = cfg.monotone[:F]
+        mono_j = jnp.asarray(mono)
+    if cfg.has_interaction:
+        gmask_np = np.zeros((len(cfg.interaction), F), bool)
+        for gi, grp in enumerate(cfg.interaction):
+            for f in grp:
+                if f < F:
+                    gmask_np[gi, f] = True
+        gmask = jnp.asarray(gmask_np)  # [G, F]
+
     gh = jnp.stack([grad, hess], axis=-1)  # [n, 2]
 
     def body(d: jax.Array, state):
         (pos, is_split, feature, split_bin, split_cond, default_left,
-         node_g, node_h, node_w, loss_chg) = state
+         node_g, node_h, node_w, loss_chg, lo_b, up_b, used) = state
 
         offset = (1 << d) - 1  # first heap id of this level
         width = 1 << d  # real nodes at this level (<= Nmax)
@@ -155,23 +302,13 @@ def grow_tree(
         Gtot = hist[:, 0, :, 0].sum(-1)  # [Nmax]
         Htot = hist[:, 0, :, 1].sum(-1)
 
-        # ---- split evaluation over [node, direction, feature, bin] ----
-        g_b = hist[:, :, :B, 0]
-        h_b = hist[:, :, :B, 1]
-        g_miss = hist[:, :, B, 0]  # [Nmax, F]
-        h_miss = hist[:, :, B, 1]
-        GL = jnp.cumsum(g_b, axis=-1)
-        HL = jnp.cumsum(h_b, axis=-1)
-        # dir 0: missing goes right (default_left=False); dir 1: missing left
-        GLd = jnp.stack([GL, GL + g_miss[..., None]], axis=1)  # [Nmax, 2, F, B]
-        HLd = jnp.stack([HL, HL + h_miss[..., None]], axis=1)
-        GRd = Gtot[:, None, None, None] - GLd
-        HRd = Htot[:, None, None, None] - HLd
-        gain = calc_gain(GLd, HLd, p) + calc_gain(GRd, HRd, p)
-        parent_gain = calc_gain(Gtot, Htot, p)
-        chg = gain - parent_gain[:, None, None, None]
+        slots = offset + jnp.arange(Nmax)
+        slot_real = jnp.arange(Nmax) < width
+        widx = jnp.where(slot_real, slots, max_nodes)  # OOB -> dropped
+        node_lo = lo_b[widx.clip(0, max_nodes - 1)]  # [Nmax] per-node bounds
+        node_up = up_b[widx.clip(0, max_nodes - 1)]
 
-        valid = (HLd >= p.min_child_weight) & (HRd >= p.min_child_weight)
+        # ---- per-node feature masks: column sampling + interaction ----
         fmask = tree_mask
         if cfg.colsample_bylevel < 1.0:
             kl = jax.random.fold_in(k_level, d)
@@ -183,31 +320,28 @@ def grow_tree(
             )
         else:
             node_fmask = jnp.broadcast_to(fmask[None, :], (Nmax, F))
-        valid = valid & node_fmask[:, None, :, None]
+        if cfg.has_interaction:
+            node_used = used[widx.clip(0, max_nodes - 1)]  # [Nmax, F]
+            node_fmask = node_fmask & interaction_allowed(node_used, gmask)
 
-        score = jnp.where(valid, chg, -jnp.inf)
-        flat = score.reshape(Nmax, -1)
-        best_idx = jnp.argmax(flat, axis=-1)  # [Nmax]
-        best_loss = jnp.take_along_axis(flat, best_idx[:, None], axis=1)[:, 0]
-        FB = F * B
-        best_dir = best_idx // FB
-        rem = best_idx % FB
-        best_f = (rem // B).astype(jnp.int32)
-        best_b = (rem % B).astype(jnp.int32)
+        # ---- split evaluation (shared evaluator) ----
+        dec = eval_splits(
+            hist, Gtot, Htot, p, node_fmask, B,
+            mono=mono_j if cfg.has_monotone else None,
+            node_lo=node_lo if cfg.has_monotone else None,
+            node_up=node_up if cfg.has_monotone else None,
+        )
+        best_loss, best_dir, best_f, best_b = dec.loss, dec.dir, dec.f, dec.b
+        w_node = dec.w_node
 
-        slot_real = jnp.arange(Nmax) < width
         can_split = (best_loss > RT_EPS) & (Htot > 0.0) & slot_real
 
-        # best-split child stats (gathered once; become next level's totals)
-        flat4 = lambda a: jnp.take_along_axis(a.reshape(Nmax, -1), best_idx[:, None], axis=1)[:, 0]
-        GLb, HLb = flat4(GLd), flat4(HLd)
+        GLb, HLb = dec.GL, dec.HL
         GRb, HRb = Gtot - GLb, Htot - HLb
 
         cond = cut_values[best_f, best_b]  # [Nmax]
 
         # ---- write this level's nodes into the heap arrays ----
-        slots = offset + jnp.arange(Nmax)
-        widx = jnp.where(slot_real, slots, max_nodes)  # OOB -> dropped
         is_split = is_split.at[widx].set(can_split, mode="drop")
         feature = feature.at[widx].set(best_f, mode="drop")
         split_bin = split_bin.at[widx].set(best_b, mode="drop")
@@ -215,20 +349,35 @@ def grow_tree(
         default_left = default_left.at[widx].set(best_dir == 1, mode="drop")
         node_g = node_g.at[widx].set(Gtot, mode="drop")
         node_h = node_h.at[widx].set(Htot, mode="drop")
-        node_w = node_w.at[widx].set(calc_weight(Gtot, Htot, p), mode="drop")
+        node_w = node_w.at[widx].set(w_node, mode="drop")
         loss_chg = loss_chg.at[widx].set(jnp.where(can_split, best_loss, 0.0), mode="drop")
+
+        # children weights/bounds for the next level
+        if cfg.has_monotone:
+            l_lo, l_up, r_lo, r_up, wl_c, wr_c = child_bounds_and_weights(
+                p, mono_j[best_f], GLb, HLb, GRb, HRb, node_lo, node_up
+            )
+        else:
+            wl_c = calc_weight(GLb, HLb, p)
+            wr_c = calc_weight(GRb, HRb, p)
 
         # pre-write children stats/weights — the only way depth-max leaves
         # (never histogrammed) get their values; inner nodes are refreshed
         # from their own histogram next iteration
-        cidx = jnp.where(can_split, 2 * slots + 1, max_nodes)
-        node_g = node_g.at[cidx].set(GLb, mode="drop")
-        node_h = node_h.at[cidx].set(HLb, mode="drop")
-        node_w = node_w.at[cidx].set(calc_weight(GLb, HLb, p), mode="drop")
-        cidx = jnp.where(can_split, 2 * slots + 2, max_nodes)
-        node_g = node_g.at[cidx].set(GRb, mode="drop")
-        node_h = node_h.at[cidx].set(HRb, mode="drop")
-        node_w = node_w.at[cidx].set(calc_weight(GRb, HRb, p), mode="drop")
+        lidx = jnp.where(can_split, 2 * slots + 1, max_nodes)
+        ridx = jnp.where(can_split, 2 * slots + 2, max_nodes)
+        node_g = node_g.at[lidx].set(GLb, mode="drop").at[ridx].set(GRb, mode="drop")
+        node_h = node_h.at[lidx].set(HLb, mode="drop").at[ridx].set(HRb, mode="drop")
+        node_w = node_w.at[lidx].set(wl_c, mode="drop").at[ridx].set(wr_c, mode="drop")
+        if cfg.has_monotone:
+            lo_b = lo_b.at[lidx].set(l_lo, mode="drop").at[ridx].set(r_lo, mode="drop")
+            up_b = up_b.at[lidx].set(l_up, mode="drop").at[ridx].set(r_up, mode="drop")
+        if cfg.has_interaction:
+            child_used = used[widx.clip(0, max_nodes - 1)] | jax.nn.one_hot(
+                best_f, F, dtype=bool
+            )
+            used = used.at[lidx].set(child_used, mode="drop")
+            used = used.at[ridx].set(child_used, mode="drop")
 
         # ---- partition: route rows of split nodes to their children ----
         goes = is_split[pos]
@@ -241,8 +390,12 @@ def grow_tree(
         pos = jnp.where(goes, jnp.where(goleft, 2 * pos + 1, 2 * pos + 2), pos)
 
         return (pos, is_split, feature, split_bin, split_cond, default_left,
-                node_g, node_h, node_w, loss_chg)
+                node_g, node_h, node_w, loss_chg, lo_b, up_b, used)
 
+    # constraint state tensors are 1-element dummies when unused, so the
+    # compiled program carries no overhead for the common case
+    n_b = max_nodes if cfg.has_monotone else 1
+    n_u = max_nodes if cfg.has_interaction else 1
     init = (
         jnp.zeros((n,), jnp.int32),
         jnp.zeros((max_nodes,), bool),
@@ -254,6 +407,9 @@ def grow_tree(
         jnp.zeros((max_nodes,), jnp.float32),
         jnp.zeros((max_nodes,), jnp.float32),
         jnp.zeros((max_nodes,), jnp.float32),
+        jnp.full((n_b,), -_INF),
+        jnp.full((n_b,), _INF),
+        jnp.zeros((n_u, F), bool),
     )
     if max_depth == 0:
         state = init
@@ -266,12 +422,13 @@ def grow_tree(
             state[0], state[1], state[2], state[3], state[4], state[5],
             state[6].at[0].set(G), state[7].at[0].set(H),
             state[8].at[0].set(calc_weight(G, H, p)), state[9],
+            state[10], state[11], state[12],
         )
     else:
         state = jax.lax.fori_loop(0, max_depth, body, init)
 
     (pos, is_split, feature, split_bin, split_cond, default_left,
-     node_g, node_h, node_w, loss_chg) = state
+     node_g, node_h, node_w, loss_chg, _, _, _) = state
     return HeapTree(
         is_split=is_split, feature=feature, split_bin=split_bin,
         split_cond=split_cond, default_left=default_left,

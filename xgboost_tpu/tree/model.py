@@ -20,7 +20,7 @@ Node conventions (same as reference):
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -144,6 +144,95 @@ class RegTree:
             loss_changes=lchg,
             sum_hessian=shess,
         )
+
+    @classmethod
+    def from_alloc(
+        cls,
+        left: np.ndarray,
+        right: np.ndarray,
+        feature: np.ndarray,
+        split_cond: np.ndarray,
+        default_left: np.ndarray,
+        weight: np.ndarray,
+        loss_chg: np.ndarray,
+        sum_hess: np.ndarray,
+        n_nodes: int,
+        eta: float,
+        min_split_loss: float = 0.0,
+    ) -> Tuple["RegTree", np.ndarray]:
+        """Build from allocation-ordered arrays (lossguide grower output),
+        applying gamma pruning (updater_prune.cc analog) and compacting via
+        BFS. Returns (tree, leaf_value_of_original_id) where the second is
+        the [len(left)] cache-update map: every ORIGINAL node id -> the leaf
+        value that governs it after pruning (rows' grower positions index
+        original ids)."""
+        M = len(left)
+        lp = left[:n_nodes].copy()
+        rp = right[:n_nodes].copy()
+        if min_split_loss > 0.0:
+            changed = True
+            while changed:
+                changed = False
+                for i in range(n_nodes - 1, -1, -1):
+                    l, r = lp[i], rp[i]
+                    if l == -1:
+                        continue
+                    if lp[l] == -1 and lp[r] == -1 and loss_chg[i] < min_split_loss:
+                        lp[i] = rp[i] = -1
+                        changed = True
+
+        # cache map over ORIGINAL ids (children always have larger ids,
+        # so one ascending pass propagates pruned-leaf values down)
+        leaf_val = np.full(M, np.nan, np.float32)
+        for i in range(n_nodes):
+            if np.isnan(leaf_val[i]) and lp[i] == -1:
+                leaf_val[i] = eta * weight[i]
+            if left[i] != -1 and not np.isnan(leaf_val[i]):
+                leaf_val[left[i]] = leaf_val[i]
+                leaf_val[right[i]] = leaf_val[i]
+
+        # BFS compaction
+        order = []
+        compact_of = {0: 0}
+        queue = [0]
+        while queue:
+            i = queue.pop(0)
+            order.append(i)
+            if lp[i] != -1:
+                queue.append(lp[i])
+                queue.append(rp[i])
+        for idx, i in enumerate(order):
+            compact_of[i] = idx
+        nn = len(order)
+        lc = np.full(nn, -1, np.int32)
+        rc = np.full(nn, -1, np.int32)
+        par = np.full(nn, -1, np.int32)
+        sidx = np.zeros(nn, np.int32)
+        scond = np.zeros(nn, np.float32)
+        dleft = np.zeros(nn, bool)
+        bw = np.zeros(nn, np.float32)
+        lchg = np.zeros(nn, np.float32)
+        shess = np.zeros(nn, np.float32)
+        for idx, i in enumerate(order):
+            bw[idx] = eta * weight[i]
+            shess[idx] = sum_hess[i]
+            if lp[i] != -1:
+                lc[idx] = compact_of[lp[i]]
+                rc[idx] = compact_of[rp[i]]
+                par[lc[idx]] = idx
+                par[rc[idx]] = idx
+                sidx[idx] = feature[i]
+                scond[idx] = split_cond[i]
+                dleft[idx] = bool(default_left[i])
+                lchg[idx] = loss_chg[i]
+            else:
+                scond[idx] = eta * weight[i]
+        tree = cls(
+            left_children=lc, right_children=rc, parents=par,
+            split_indices=sidx, split_conditions=scond, default_left=dleft,
+            base_weights=bw, loss_changes=lchg, sum_hessian=shess,
+        )
+        return tree, leaf_val
 
     # ------------------------------------------------------------------
     # XGBoost-compatible JSON (doc/model.schema layout)
