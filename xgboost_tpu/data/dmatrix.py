@@ -177,6 +177,12 @@ class DMatrix:
         self.info.feature_types = list(types) if types is not None else None
 
     # ---- quantized view ----
+    def categorical_features(self) -> List[int]:
+        ft = self.info.feature_types
+        if not ft:
+            return []
+        return [i for i, t in enumerate(ft) if t in ("c", "categorical")]
+
     def get_binned(
         self, max_bin: int = 256, sketch_weights: Optional[np.ndarray] = None
     ) -> BinnedMatrix:
@@ -184,9 +190,37 @@ class DMatrix:
         ``GetBatches<GHistIndexMatrix>(BatchParam{max_bin})``)."""
         bm = self._binned.get(max_bin)
         if bm is None:
-            bm = BinnedMatrix.from_dense(self._data, max_bin=max_bin, weights=sketch_weights)
+            cat = self.categorical_features()
+            if cat:
+                self._validate_categorical(cat, max_bin)
+            bm = BinnedMatrix.from_dense(
+                self._data, max_bin=max_bin, weights=sketch_weights,
+                categorical=cat,
+            )
             self._binned[max_bin] = bm
         return bm
+
+    def _validate_categorical(self, cat: List[int], max_bin: int) -> None:
+        """Categorical codes must be non-negative integers < max_bin: the
+        identity binning and the predictor's exact-equality decision must
+        agree, so out-of-range or fractional codes are an error (the
+        reference likewise validates categories, common/categorical.h
+        InvalidCat checks)."""
+        for f in cat:
+            col = self._data[:, f]
+            valid = col[~np.isnan(col)]
+            if valid.size == 0:
+                continue
+            if (valid < 0).any() or (valid != np.floor(valid)).any():
+                raise ValueError(
+                    f"categorical feature {f} has negative or non-integer codes"
+                )
+            mx = float(valid.max())
+            if mx >= max_bin:
+                raise ValueError(
+                    f"categorical feature {f} has {int(mx) + 1} categories, "
+                    f"exceeding max_bin={max_bin}; raise max_bin"
+                )
 
     def slice(self, rindex: Any) -> "DMatrix":
         rindex = np.asarray(rindex)
@@ -215,8 +249,13 @@ class QuantileDMatrix(DMatrix):
         super().__init__(data, label, **kwargs)
         self.max_bin = max_bin
         cuts: Optional[HistogramCuts] = None
+        cat = self.categorical_features()
         if ref is not None and ref._binned:
-            cuts = next(iter(ref._binned.values())).cuts
+            ref_bm = next(iter(ref._binned.values()))
+            cuts = ref_bm.cuts
+            if not cat:
+                cat = list(ref_bm.categorical)
         self._binned[max_bin] = BinnedMatrix.from_dense(
-            self._data, max_bin=max_bin, weights=self.info.weight, cuts=cuts
+            self._data, max_bin=max_bin, weights=self.info.weight, cuts=cuts,
+            categorical=cat,
         )

@@ -25,7 +25,7 @@ from __future__ import annotations
 
 import dataclasses
 from functools import partial
-from typing import Optional
+from typing import Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -102,15 +102,28 @@ def compute_cuts(
     X: np.ndarray | jax.Array,
     max_bin: int = 256,
     weights: Optional[np.ndarray | jax.Array] = None,
+    categorical: Optional[Sequence[int]] = None,
 ) -> HistogramCuts:
-    """Entry point, analog of ``SketchOnDMatrix`` (``hist_util.cc:132``)."""
+    """Entry point, analog of ``SketchOnDMatrix`` (``hist_util.cc:132``).
+
+    Categorical features get IDENTITY cuts ``[1, 2, ..., max_bin]`` so a
+    category code ``c`` lands in bin ``c`` — one bin per category, the same
+    one-bin-per-category layout the reference builds for categorical data
+    (``hist_util.cc`` AddCutPoint categorical path)."""
     X = jnp.asarray(X, dtype=jnp.float32)
     if weights is None or (hasattr(weights, "size") and weights.size == 0):
         weights = jnp.ones((X.shape[0],), dtype=jnp.float32)
     else:
         weights = jnp.asarray(weights, dtype=jnp.float32)
     values, min_vals = _cuts_kernel(X, weights, max_bin)
-    return HistogramCuts(values=np.asarray(values), min_vals=np.asarray(min_vals))
+    values = np.array(values)
+    min_vals = np.array(min_vals)
+    if categorical:
+        ident = np.arange(1, max_bin + 1, dtype=np.float32)
+        for f in categorical:
+            values[f] = ident
+            min_vals[f] = 0.0
+    return HistogramCuts(values=values, min_vals=min_vals)
 
 
 @jax.jit
@@ -161,6 +174,9 @@ class BinnedMatrix:
     def n_features(self) -> int:
         return int(self.bins.shape[1])
 
+    # feature ids binned as categorical (identity cuts)
+    categorical: Tuple[int, ...] = ()
+
     @classmethod
     def from_dense(
         cls,
@@ -168,7 +184,9 @@ class BinnedMatrix:
         max_bin: int = 256,
         weights: Optional[np.ndarray] = None,
         cuts: Optional[HistogramCuts] = None,
+        categorical: Optional[Sequence[int]] = None,
     ) -> "BinnedMatrix":
+        cat = tuple(categorical) if categorical else ()
         if cuts is None:
-            cuts = compute_cuts(X, max_bin=max_bin, weights=weights)
-        return cls(cuts=cuts, bins=bin_matrix(X, cuts))
+            cuts = compute_cuts(X, max_bin=max_bin, weights=weights, categorical=cat)
+        return cls(cuts=cuts, bins=bin_matrix(X, cuts), categorical=cat)

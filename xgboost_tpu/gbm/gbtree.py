@@ -9,6 +9,7 @@ line from DropTrees:914 / NormalizeTrees:963).
 
 from __future__ import annotations
 
+import dataclasses as _dc
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -127,6 +128,9 @@ class GBTree:
         final grower position, no predictor pass (gbtree.cc:219)."""
         tp = self.train_param
         cfg = self._grow_params()
+        if getattr(binned, "categorical", ()):
+            cfg = _dc.replace(cfg, categorical=tuple(binned.categorical))
+        cat_mask = cfg.cat_mask_np(binned.n_features) if cfg.has_categorical else None
         cuts = binned.cuts
         cut_vals = jnp.asarray(cuts.values)
         lossguide = tp.grow_policy == "lossguide"
@@ -161,6 +165,7 @@ class GBTree:
                         np.asarray(alloc.default_left), np.asarray(alloc.node_weight),
                         np.asarray(alloc.loss_chg), np.asarray(alloc.node_h),
                         int(alloc.n_nodes), eta=tp.eta, min_split_loss=tp.gamma,
+                        split_bin=np.asarray(alloc.split_bin), cat_features=cat_mask,
                     )
                     positions = alloc.positions
                 else:
@@ -177,6 +182,8 @@ class GBTree:
                         loss_chg,
                         np.asarray(heap.node_h),
                         eta=tp.eta,
+                        split_bin=np.asarray(heap.split_bin),
+                        cat_features=cat_mask,
                     )
                     lmap_np = leaf_value_map(pruned, np.asarray(heap.node_weight), tp.eta)
                     positions = heap.positions

@@ -87,10 +87,10 @@ def _tree_shap(tree, x: np.ndarray, phi: np.ndarray, node: int, path: List[_Path
     v = x[f]
     if np.isnan(v):
         hot = tree.left_children[node] if tree.default_left[node] else tree.right_children[node]
-    elif v < tree.split_conditions[node]:
-        hot = tree.left_children[node]
     else:
-        hot = tree.right_children[node]
+        is_cat = tree.split_type is not None and tree.split_type[node] == 1
+        goleft = (v != tree.split_conditions[node]) if is_cat else (v < tree.split_conditions[node])
+        hot = tree.left_children[node] if goleft else tree.right_children[node]
     cold = (
         tree.right_children[node]
         if hot == tree.left_children[node]
@@ -141,10 +141,10 @@ def _saabas(tree, x: np.ndarray, phi: np.ndarray) -> None:
         v = x[f]
         if np.isnan(v):
             nxt = tree.left_children[i] if tree.default_left[i] else tree.right_children[i]
-        elif v < tree.split_conditions[i]:
-            nxt = tree.left_children[i]
         else:
-            nxt = tree.right_children[i]
+            is_cat = tree.split_type is not None and tree.split_type[i] == 1
+            goleft = (v != tree.split_conditions[i]) if is_cat else (v < tree.split_conditions[i])
+            nxt = tree.left_children[i] if goleft else tree.right_children[i]
         nv = node_value(nxt)
         phi[f] += nv - cur
         cur = nv

@@ -27,6 +27,7 @@ class StackedForest(NamedTuple):
     feature: jax.Array  # int32 [T, N]
     cond: jax.Array  # f32 [T, N] (leaf value at leaves)
     default_left: jax.Array  # bool [T, N]
+    split_type: jax.Array  # bool [T, N] (True = one-hot categorical node)
     tree_group: jax.Array  # int32 [T]
     max_depth: int  # static walk bound
     n_groups: int
@@ -41,6 +42,7 @@ def stack_forest(trees, tree_info, n_groups: int) -> StackedForest:
             left=z, right=z, feature=z,
             cond=jnp.zeros((0, 1), jnp.float32),
             default_left=jnp.zeros((0, 1), bool),
+            split_type=jnp.zeros((0, 1), bool),
             tree_group=jnp.zeros((0,), jnp.int32), max_depth=1, n_groups=n_groups,
         )
     N = max(t.num_nodes for t in trees)
@@ -59,6 +61,10 @@ def stack_forest(trees, tree_info, n_groups: int) -> StackedForest:
         feature=jnp.asarray(pad(lambda t: t.split_indices, 0, np.int32)),
         cond=jnp.asarray(pad(lambda t: t.split_conditions, 0.0, np.float32)),
         default_left=jnp.asarray(pad(lambda t: t.default_left, False, bool)),
+        split_type=jnp.asarray(pad(
+            lambda t: (t.split_type if t.split_type is not None
+                       else np.zeros(t.num_nodes, np.int8)).astype(bool),
+            False, bool)),
         tree_group=jnp.asarray(np.asarray(tree_info, np.int32)),
         max_depth=md,
         n_groups=n_groups,
@@ -69,36 +75,40 @@ def stack_forest(trees, tree_info, n_groups: int) -> StackedForest:
 def _walk_leaves(
     X: jax.Array,  # [n, F] f32 with NaN missing
     left: jax.Array, right: jax.Array, feature: jax.Array,
-    cond: jax.Array, default_left: jax.Array, max_depth: int,
+    cond: jax.Array, default_left: jax.Array, split_type: jax.Array,
+    max_depth: int,
 ) -> jax.Array:
-    """Leaf index of every (tree, row): returns int32 [T, n]."""
+    """Leaf index of every (tree, row): returns int32 [T, n]. Numerical
+    nodes: left iff v < cond; one-hot categorical nodes: the split category
+    goes right (predict_fn.h / common/categorical.h decision)."""
     n = X.shape[0]
 
-    def one_tree(lc, rc, fi, co, dl):
+    def one_tree(lc, rc, fi, co, dl, st):
         pos = jnp.zeros((n,), jnp.int32)
 
         def body(_, pos):
             leaf = lc[pos] == -1
             f = fi[pos]
             v = jnp.take_along_axis(X, f[:, None], axis=1)[:, 0]
-            goleft = jnp.where(jnp.isnan(v), dl[pos], v < co[pos])
+            present = jnp.where(st[pos], v != co[pos], v < co[pos])
+            goleft = jnp.where(jnp.isnan(v), dl[pos], present)
             nxt = jnp.where(goleft, lc[pos], rc[pos])
             return jnp.where(leaf, pos, nxt)
 
         return jax.lax.fori_loop(0, max_depth, body, pos)
 
-    return jax.vmap(one_tree)(left, right, feature, cond, default_left)
+    return jax.vmap(one_tree)(left, right, feature, cond, default_left, split_type)
 
 
 @partial(jax.jit, static_argnames=("n_groups", "max_depth"))
 def _predict_margin_kernel(
     X: jax.Array,
-    left, right, feature, cond, default_left, tree_group,
+    left, right, feature, cond, default_left, split_type, tree_group,
     tree_weights: jax.Array,  # f32 [T] (DART scaling; ones otherwise)
     base_margin: jax.Array,  # [n, n_groups]
     n_groups: int, max_depth: int,
 ) -> jax.Array:
-    leaves = _walk_leaves(X, left, right, feature, cond, default_left, max_depth)  # [T, n]
+    leaves = _walk_leaves(X, left, right, feature, cond, default_left, split_type, max_depth)  # [T, n]
     leaf_vals = jnp.take_along_axis(cond, leaves, axis=1) * tree_weights[:, None]  # [T, n]
     # sum per output group (multiclass: one tree per class per round,
     # reference gbtree.cc:219 gradient slicing)
@@ -123,8 +133,8 @@ def predict_margin(
     return _predict_margin_kernel(
         jnp.asarray(X, jnp.float32),
         forest.left, forest.right, forest.feature, forest.cond,
-        forest.default_left, forest.tree_group, tw, base_margin,
-        forest.n_groups, forest.max_depth,
+        forest.default_left, forest.split_type, forest.tree_group, tw,
+        base_margin, forest.n_groups, forest.max_depth,
     )
 
 
@@ -135,6 +145,6 @@ def predict_leaf(forest: StackedForest, X: jax.Array) -> jax.Array:
     leaves = _walk_leaves(
         jnp.asarray(X, jnp.float32),
         forest.left, forest.right, forest.feature, forest.cond,
-        forest.default_left, forest.max_depth,
+        forest.default_left, forest.split_type, forest.max_depth,
     )
     return leaves.T

@@ -77,6 +77,10 @@ class GrowParams:
     monotone: Tuple[int, ...] = ()
     # interaction groups as tuples of feature ids (empty = unconstrained)
     interaction: Tuple[Tuple[int, ...], ...] = ()
+    # feature ids treated as categorical (one-hot splits: one category vs
+    # rest — reference's max_cat_to_onehot regime, evaluate_splits.h:61-203;
+    # optimal-partition splits are a planned extension)
+    categorical: Tuple[int, ...] = ()
     # name of a mesh axis to psum histograms over (None = single device).
     # This is THE distributed hook: the reference's histogram AllReduce
     # (hist/histogram.h:201, updater_gpu_hist.cu:526) becomes one psum.
@@ -97,6 +101,17 @@ class GrowParams:
     @property
     def has_interaction(self) -> bool:
         return len(self.interaction) > 0
+
+    @property
+    def has_categorical(self) -> bool:
+        return len(self.categorical) > 0
+
+    def cat_mask_np(self, n_features: int) -> np.ndarray:
+        m = np.zeros(n_features, bool)
+        for f in self.categorical:
+            if f < n_features:
+                m[f] = True
+        return m
 
 
 class HeapTree(NamedTuple):
@@ -144,12 +159,15 @@ def eval_splits(
     mono: Optional[jax.Array] = None,  # [F] -1/0/+1
     node_lo: Optional[jax.Array] = None,  # [K] weight bounds
     node_up: Optional[jax.Array] = None,
+    cat_feats: Optional[jax.Array] = None,  # [F] bool: categorical features
 ) -> SplitDecision:
     """The ONE split evaluator (used by both depthwise and lossguide growers
     — the reference keeps a single HistEvaluator for the same reason,
     hist/evaluate_splits.h:26). Scans cumulative G/H over bins for both
     missing-direction hypotheses, applies min_child_weight / feature masks /
-    monotone bound clamping, and argmaxes loss_chg per node."""
+    monotone bound clamping, and argmaxes loss_chg per node. Categorical
+    features score one-hot candidates instead: bin b means "category b goes
+    right, the rest left" (evaluate_splits.h one-hot path)."""
     K, F = hist.shape[0], hist.shape[1]
     g_b, h_b = hist[:, :, :B, 0], hist[:, :, :B, 1]
     g_miss, h_miss = hist[:, :, B, 0], hist[:, :, B, 1]
@@ -158,6 +176,14 @@ def eval_splits(
     # dir 0: missing goes right (default_left=False); dir 1: missing left
     GLd = jnp.stack([GL, GL + g_miss[..., None]], axis=1)  # [K, 2, F, B]
     HLd = jnp.stack([HL, HL + h_miss[..., None]], axis=1)
+    if cat_feats is not None:
+        # one-hot: left = all-but-category-b (+ missing when default-left)
+        Gp, Hp = GL[..., -1:], HL[..., -1:]  # present-value totals
+        GLc = jnp.stack([Gp - g_b, Gp - g_b + g_miss[..., None]], axis=1)
+        HLc = jnp.stack([Hp - h_b, Hp - h_b + h_miss[..., None]], axis=1)
+        sel = cat_feats[None, None, :, None]
+        GLd = jnp.where(sel, GLc, GLd)
+        HLd = jnp.where(sel, HLc, HLd)
     GRd = Gtot[:, None, None, None] - GLd
     HRd = Htot[:, None, None, None] - HLd
 
@@ -275,6 +301,7 @@ def grow_tree(
                 if f < F:
                     gmask_np[gi, f] = True
         gmask = jnp.asarray(gmask_np)  # [G, F]
+    cat_j = jnp.asarray(cfg.cat_mask_np(F)) if cfg.has_categorical else None
 
     gh = jnp.stack([grad, hess], axis=-1)  # [n, 2]
 
@@ -330,6 +357,7 @@ def grow_tree(
             mono=mono_j if cfg.has_monotone else None,
             node_lo=node_lo if cfg.has_monotone else None,
             node_up=node_up if cfg.has_monotone else None,
+            cat_feats=cat_j,
         )
         best_loss, best_dir, best_f, best_b = dec.loss, dec.dir, dec.f, dec.b
         w_node = dec.w_node
@@ -386,7 +414,11 @@ def grow_tree(
         dl_of = default_left[pos]
         bv = jnp.take_along_axis(bins32, f_of[:, None], axis=1)[:, 0]
         missing = bv == B
-        goleft = jnp.where(missing, dl_of, bv <= b_of)
+        present_goleft = bv <= b_of
+        if cfg.has_categorical:
+            # categorical one-hot: the split category goes right
+            present_goleft = jnp.where(cat_j[f_of], bv != b_of, present_goleft)
+        goleft = jnp.where(missing, dl_of, present_goleft)
         pos = jnp.where(goes, jnp.where(goleft, 2 * pos + 1, 2 * pos + 2), pos)
 
         return (pos, is_split, feature, split_bin, split_cond, default_left,

@@ -104,6 +104,7 @@ def grow_tree_lossguide(
                 if f < F:
                     gmask_np[gi, f] = True
         gmask = jnp.asarray(gmask_np)
+    cat_j = jnp.asarray(cfg.cat_mask_np(F)) if cfg.has_categorical else None
 
     gh = jnp.stack([grad, hess], axis=-1)
     gh_full = jnp.broadcast_to(gh[:, None, :], (n, F, 2)).reshape(-1, 2)
@@ -174,6 +175,7 @@ def grow_tree_lossguide(
         mono=mono_j if cfg.has_monotone else None,
         node_lo=lo_b[:1] if cfg.has_monotone else None,
         node_up=up_b[:1] if cfg.has_monotone else None,
+        cat_feats=cat_j,
     )
     node_g = node_g.at[0].set(G0)
     node_h = node_h.at[0].set(H0)
@@ -242,7 +244,10 @@ def grow_tree_lossguide(
 
         # ---- partition the picked node's rows ----
         bv = bins32[:, f]
-        goleft = jnp.where(bv == B, dr == 1, bv <= b)
+        present = bv <= b
+        if cfg.has_categorical:
+            present = jnp.where(cat_j[f], bv != b, present)
+        goleft = jnp.where(bv == B, dr == 1, present)
         at_pick = (pos == pick) & do
         pos = jnp.where(at_pick, jnp.where(goleft, l_id, r_id), pos)
 
@@ -264,6 +269,7 @@ def grow_tree_lossguide(
             mono=mono_j if cfg.has_monotone else None,
             node_lo=jnp.stack([l_lo, r_lo]) if cfg.has_monotone else None,
             node_up=jnp.stack([l_up, r_up]) if cfg.has_monotone else None,
+            cat_feats=cat_j,
         )
         bl = dec.loss
         if max_depth > 0:

@@ -88,11 +88,14 @@ class RegTree:
         loss_chg: np.ndarray,
         sum_hess: np.ndarray,
         eta: float,
+        split_bin: Optional[np.ndarray] = None,
+        cat_features: Optional[np.ndarray] = None,  # [F] bool
     ) -> "RegTree":
         """Compact a heap-layout tree (children of heap node i at 2i+1/2i+2)
         into BFS-ordered SoA. ``is_split`` must already be gamma-pruned
         (see ``grow.prune_heap``, the analog of the reference's chained
-        ``updater_prune.cc``)."""
+        ``updater_prune.cc``). For one-hot categorical splits the node's
+        condition is the category code itself (split_type=1)."""
         n_heap = len(is_split)
 
         # BFS over existing heap nodes
@@ -119,6 +122,7 @@ class RegTree:
         bw = np.zeros(n, np.float32)
         lchg = np.zeros(n, np.float32)
         shess = np.zeros(n, np.float32)
+        stype = np.zeros(n, np.int8)
         for idx, h in enumerate(order):
             bw[idx] = eta * weight[h]
             shess[idx] = sum_hess[h]
@@ -128,7 +132,16 @@ class RegTree:
                 lc[idx] = compact_of[2 * h + 1]
                 rc[idx] = compact_of[2 * h + 2]
                 sidx[idx] = feature[h]
-                scond[idx] = split_cond[h]
+                is_cat = (
+                    cat_features is not None
+                    and split_bin is not None
+                    and cat_features[feature[h]]
+                )
+                if is_cat:
+                    stype[idx] = 1
+                    scond[idx] = float(split_bin[h])  # the category code
+                else:
+                    scond[idx] = split_cond[h]
                 dleft[idx] = bool(default_left[h])
                 lchg[idx] = loss_chg[h]
             else:
@@ -143,6 +156,7 @@ class RegTree:
             base_weights=bw,
             loss_changes=lchg,
             sum_hessian=shess,
+            split_type=stype,
         )
 
     @classmethod
@@ -159,6 +173,8 @@ class RegTree:
         n_nodes: int,
         eta: float,
         min_split_loss: float = 0.0,
+        split_bin: Optional[np.ndarray] = None,
+        cat_features: Optional[np.ndarray] = None,
     ) -> Tuple["RegTree", np.ndarray]:
         """Build from allocation-ordered arrays (lossguide grower output),
         applying gamma pruning (updater_prune.cc analog) and compacting via
@@ -213,6 +229,7 @@ class RegTree:
         bw = np.zeros(nn, np.float32)
         lchg = np.zeros(nn, np.float32)
         shess = np.zeros(nn, np.float32)
+        stype = np.zeros(nn, np.int8)
         for idx, i in enumerate(order):
             bw[idx] = eta * weight[i]
             shess[idx] = sum_hess[i]
@@ -222,7 +239,16 @@ class RegTree:
                 par[lc[idx]] = idx
                 par[rc[idx]] = idx
                 sidx[idx] = feature[i]
-                scond[idx] = split_cond[i]
+                is_cat = (
+                    cat_features is not None
+                    and split_bin is not None
+                    and cat_features[feature[i]]
+                )
+                if is_cat:
+                    stype[idx] = 1
+                    scond[idx] = float(split_bin[i])
+                else:
+                    scond[idx] = split_cond[i]
                 dleft[idx] = bool(default_left[i])
                 lchg[idx] = loss_chg[i]
             else:
@@ -231,8 +257,32 @@ class RegTree:
             left_children=lc, right_children=rc, parents=par,
             split_indices=sidx, split_conditions=scond, default_left=dleft,
             base_weights=bw, loss_changes=lchg, sum_hessian=shess,
+            split_type=stype,
         )
         return tree, leaf_val
+
+    def _categories_json(self) -> dict:
+        cats: List[int] = []
+        nodes: List[int] = []
+        segments: List[int] = []
+        sizes: List[int] = []
+        if self.split_type is not None:
+            for i in range(self.num_nodes):
+                if self.split_type[i] == 1 and self.left_children[i] != -1:
+                    nodes.append(i)
+                    segments.append(len(cats))
+                    if self.categories is not None and i < len(self.categories or []):
+                        cs = [int(c) for c in self.categories[i]]
+                    else:
+                        cs = [int(self.split_conditions[i])]  # one-hot
+                    cats.extend(cs)
+                    sizes.append(len(cs))
+        return {
+            "categories": cats,
+            "categories_nodes": nodes,
+            "categories_segments": segments,
+            "categories_sizes": sizes,
+        }
 
     # ------------------------------------------------------------------
     # XGBoost-compatible JSON (doc/model.schema layout)
@@ -258,10 +308,9 @@ class RegTree:
                 if self.split_type is not None
                 else [0] * n
             ),
-            "categories": [],
-            "categories_nodes": [],
-            "categories_segments": [],
-            "categories_sizes": [],
+            # one-hot categorical nodes: categories arrays in the reference's
+            # segmented layout (tree_model.cc:898-911)
+            **self._categories_json(),
             "base_weights": [float(x) for x in self.base_weights],
             "loss_changes": [float(x) for x in self.loss_changes],
             "sum_hessian": [float(x) for x in self.sum_hessian],
@@ -271,46 +320,66 @@ class RegTree:
     def from_json(cls, j: dict) -> "RegTree":
         n = len(j["left_children"])
         st = np.asarray(j.get("split_type", [0] * n), np.int8)
+        scond = np.asarray(j["split_conditions"], np.float32).copy()
+        categories: Optional[List[np.ndarray]] = None
+        cat_nodes = j.get("categories_nodes", [])
+        if cat_nodes:
+            cats = j.get("categories", [])
+            segs = j.get("categories_segments", [])
+            sizes = j.get("categories_sizes", [])
+            categories = [np.empty(0, np.int32) for _ in range(n)]
+            for node, seg, size in zip(cat_nodes, segs, sizes):
+                cs = np.asarray(cats[seg : seg + size], np.int32)
+                categories[node] = cs
+                if size == 1:
+                    # one-hot node: the predictor's equality test keys off
+                    # split_conditions (the category code)
+                    scond[node] = float(cs[0])
+                else:
+                    raise NotImplementedError(
+                        "multi-category (optimal-partition) split sets are "
+                        "not supported yet; this model needs set-membership "
+                        "decisions"
+                    )
         return cls(
             left_children=np.asarray(j["left_children"], np.int32),
             right_children=np.asarray(j["right_children"], np.int32),
             parents=np.asarray(j["parents"], np.int32),
             split_indices=np.asarray(j["split_indices"], np.int32),
-            split_conditions=np.asarray(j["split_conditions"], np.float32),
+            split_conditions=scond,
             default_left=np.asarray(j["default_left"], bool),
             base_weights=np.asarray(j.get("base_weights", [0.0] * n), np.float32),
             loss_changes=np.asarray(j.get("loss_changes", [0.0] * n), np.float32),
             sum_hessian=np.asarray(j.get("sum_hessian", [0.0] * n), np.float32),
             split_type=st,
+            categories=categories,
         )
 
     # ------------------------------------------------------------------
     # host reference predict (oracle for the XLA predictor) + dumps
     # ------------------------------------------------------------------
+    def _next(self, i: int, x: np.ndarray) -> int:
+        """One decision step (reference: predict_fn.h GetNextNode +
+        categorical Decision, common/categorical.h)."""
+        v = x[self.split_indices[i]]
+        if np.isnan(v):
+            return self.left_children[i] if self.default_left[i] else self.right_children[i]
+        if self.split_type is not None and self.split_type[i] == 1:
+            goleft = v != self.split_conditions[i]  # one-hot: category -> right
+        else:
+            goleft = v < self.split_conditions[i]
+        return self.left_children[i] if goleft else self.right_children[i]
+
     def predict_one(self, x: np.ndarray) -> float:
         i = 0
         while self.left_children[i] != -1:
-            f = self.split_indices[i]
-            v = x[f]
-            if np.isnan(v):
-                i = self.left_children[i] if self.default_left[i] else self.right_children[i]
-            elif v < self.split_conditions[i]:
-                i = self.left_children[i]
-            else:
-                i = self.right_children[i]
+            i = self._next(i, x)
         return float(self.split_conditions[i])
 
     def leaf_of(self, x: np.ndarray) -> int:
         i = 0
         while self.left_children[i] != -1:
-            f = self.split_indices[i]
-            v = x[f]
-            if np.isnan(v):
-                i = self.left_children[i] if self.default_left[i] else self.right_children[i]
-            elif v < self.split_conditions[i]:
-                i = self.left_children[i]
-            else:
-                i = self.right_children[i]
+            i = self._next(i, x)
         return i
 
     def dump_text(self, fmap: Optional[List[str]] = None, with_stats: bool = False) -> str:
