@@ -1,0 +1,207 @@
+"""Component tests: CLI, DataIter, SHAP, gblinear, DART, sampling
+(reference analogs: test_cli.py, test_data_iterator.py, test_shap.py,
+test_linear.py, test_updaters dart/sampling cases)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import xgboost_tpu as xgb
+
+
+def _data(n=1200, f=5, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f).astype(np.float32)
+    y = (X[:, 0] * 2 - X[:, 1] + 0.3 * rng.randn(n) > 0).astype(np.float32)
+    return X, y
+
+
+# ---------------------------------------------------------------- CLI
+def test_cli_train_pred_dump(tmp_path):
+    from xgboost_tpu.cli import cli_main
+
+    X, y = _data(400, 4)
+    train_csv = tmp_path / "train.csv"
+    np.savetxt(train_csv, np.column_stack([y, X]), delimiter=",", fmt="%.6g")
+    conf = tmp_path / "train.conf"
+    conf.write_text(
+        f"""# comment line
+task = train
+data = {train_csv}
+num_round = 3
+objective = binary:logistic
+max_depth = 3
+model_out = {tmp_path}/m.json
+silent = 1
+"""
+    )
+    assert cli_main([str(conf)]) == 0
+    assert (tmp_path / "m.json").exists()
+
+    pconf = tmp_path / "pred.conf"
+    pconf.write_text(
+        f"task=pred\nmodel_in={tmp_path}/m.json\ntest:data={train_csv}\nname_pred={tmp_path}/pred.txt\n"
+    )
+    assert cli_main([str(pconf)]) == 0
+    preds = np.loadtxt(tmp_path / "pred.txt")
+    assert preds.shape == (400,)
+    assert np.all((preds >= 0) & (preds <= 1))
+
+    dconf = tmp_path / "dump.conf"
+    dconf.write_text(
+        f"task=dump\nmodel_in={tmp_path}/m.json\nname_dump={tmp_path}/dump.txt\nwith_stats=1\n"
+    )
+    assert cli_main([str(dconf), f"name_dump={tmp_path}/dump.txt"]) == 0
+    text = (tmp_path / "dump.txt").read_text()
+    assert "booster[0]" in text and "leaf=" in text
+
+
+# ---------------------------------------------------------------- DataIter
+def test_streaming_quantile_dmatrix_matches_batch():
+    from xgboost_tpu.data.iterator import DataIter, StreamingQuantileDMatrix
+
+    X, y = _data(1000, 4)
+
+    class It(DataIter):
+        def __init__(self):
+            super().__init__()
+            self.i = 0
+
+        def reset(self):
+            self.i = 0
+
+        def next(self, input_data):
+            if self.i >= 4:
+                return 0
+            sl = slice(self.i * 250, (self.i + 1) * 250)
+            input_data(data=X[sl], label=y[sl])
+            self.i += 1
+            return 1
+
+    dstream = StreamingQuantileDMatrix(It(), max_bin=32)
+    dbatch = xgb.DMatrix(X, label=y)
+    p = {"objective": "binary:logistic", "max_depth": 3, "max_bin": 32}
+    b1 = xgb.train(p, dstream, 5, verbose_eval=False)
+    b2 = xgb.train(p, dbatch, 5, verbose_eval=False)
+    p1 = b1.predict(dbatch)
+    p2 = b2.predict(dbatch)
+    # streamed sketch is approximate: models agree closely but not exactly
+    assert np.corrcoef(p1, p2)[0, 1] > 0.99
+
+
+# ---------------------------------------------------------------- SHAP
+def test_shap_additivity():
+    X, y = _data(60, 4)
+    d = xgb.DMatrix(X, label=y)
+    bst = xgb.train({"objective": "binary:logistic", "max_depth": 3}, d, 3, verbose_eval=False)
+    contribs = bst.predict(d, pred_contribs=True)
+    assert contribs.shape == (60, 5)
+    margin = bst.predict(d, output_margin=True)
+    np.testing.assert_allclose(contribs.sum(axis=1), margin, rtol=1e-3, atol=1e-3)
+
+
+def test_shap_approx_additivity():
+    X, y = _data(40, 3)
+    d = xgb.DMatrix(X, label=y)
+    bst = xgb.train({"objective": "binary:logistic", "max_depth": 3}, d, 2, verbose_eval=False)
+    contribs = bst.predict(d, pred_contribs=True, approx_contribs=True)
+    margin = bst.predict(d, output_margin=True)
+    np.testing.assert_allclose(contribs.sum(axis=1), margin, rtol=1e-3, atol=1e-3)
+
+
+# ---------------------------------------------------------------- gblinear
+def test_gblinear_recovers_linear_model():
+    rng = np.random.RandomState(0)
+    X = rng.randn(2000, 3).astype(np.float32)
+    y = (1.5 * X[:, 0] - 2.0 * X[:, 1] + 0.5).astype(np.float32)
+    d = xgb.DMatrix(X, label=y)
+    bst = xgb.train(
+        {"booster": "gblinear", "objective": "reg:squarederror", "eta": 0.5,
+         "lambda": 0.0},
+        d, num_boost_round=50, verbose_eval=False,
+    )
+    pred = bst.predict(d)
+    rmse = float(np.sqrt(np.mean((pred - y) ** 2)))
+    assert rmse < 0.1, rmse
+
+
+# ---------------------------------------------------------------- DART
+def test_dart_trains_and_differs_from_gbtree():
+    X, y = _data()
+    d = xgb.DMatrix(X, label=y)
+    res = {}
+    bst = xgb.train(
+        {"booster": "dart", "objective": "binary:logistic", "max_depth": 3,
+         "rate_drop": 0.5, "eval_metric": "logloss", "seed": 1},
+        d, num_boost_round=10, evals=[(d, "train")], evals_result=res, verbose_eval=False,
+    )
+    assert res["train"]["logloss"][-1] < res["train"]["logloss"][0]
+    assert len(bst._gbm.weight_drop) == 10
+    assert any(w != 1.0 for w in bst._gbm.weight_drop)
+
+
+# ---------------------------------------------------------------- sampling
+def test_subsample_and_colsample_still_learn():
+    X, y = _data(3000, 8)
+    d = xgb.DMatrix(X, label=y)
+    res = {}
+    xgb.train(
+        {"objective": "binary:logistic", "max_depth": 4, "subsample": 0.5,
+         "colsample_bytree": 0.5, "colsample_bylevel": 0.7,
+         "colsample_bynode": 0.7, "eval_metric": "auc", "seed": 3},
+        d, num_boost_round=15, evals=[(d, "train")], evals_result=res, verbose_eval=False,
+    )
+    assert res["train"]["auc"][-1] > 0.9
+
+
+def test_colsample_bytree_restricts_features():
+    X, y = _data(800, 10)
+    d = xgb.DMatrix(X, label=y)
+    bst = xgb.train(
+        {"objective": "binary:logistic", "max_depth": 3, "colsample_bytree": 0.3,
+         "seed": 7},
+        d, num_boost_round=1, verbose_eval=False,
+    )
+    t = bst._gbm.model.trees[0]
+    used = set(t.split_indices[t.left_children != -1].tolist())
+    assert len(used) <= 3
+
+
+# ---------------------------------------------------------------- misc API
+def test_training_continuation():
+    X, y = _data()
+    d = xgb.DMatrix(X, label=y)
+    b1 = xgb.train({"objective": "binary:logistic", "max_depth": 3}, d, 5, verbose_eval=False)
+    b2 = xgb.train({"objective": "binary:logistic", "max_depth": 3}, d, 5,
+                   xgb_model=b1, verbose_eval=False)
+    assert b2.num_boosted_rounds() == 10
+    b3 = xgb.train({"objective": "binary:logistic", "max_depth": 3}, d, 10, verbose_eval=False)
+    # continued model should behave comparably to one trained in one go
+    p2, p3 = b2.predict(d), b3.predict(d)
+    assert np.corrcoef(p2, p3)[0, 1] > 0.999
+
+
+def test_booster_slicing():
+    X, y = _data()
+    d = xgb.DMatrix(X, label=y)
+    bst = xgb.train({"objective": "binary:logistic", "max_depth": 3}, d, 6, verbose_eval=False)
+    head = bst[:3]
+    assert head.num_boosted_rounds() == 3
+    np.testing.assert_allclose(
+        head.predict(d, output_margin=True),
+        bst.predict(d, output_margin=True, iteration_range=(0, 3)),
+        rtol=1e-5,
+    )
+
+
+def test_cv_runs():
+    X, y = _data(600, 4)
+    d = xgb.DMatrix(X, label=y)
+    hist = xgb.cv({"objective": "binary:logistic", "max_depth": 2}, d,
+                  num_boost_round=3, nfold=3, as_pandas=False)
+    assert "test-logloss-mean" in hist
+    assert len(hist["test-logloss-mean"]) == 3

@@ -1,0 +1,126 @@
+"""Config-file driven CLI: train | dump | pred.
+
+Reference: ``src/cli_main.cc`` (CLITask :30-35, CLIParam :37) + the
+key=value config parser (``src/common/config.h``). Usage:
+
+    python -m xgboost_tpu <config> [key=value ...]
+
+Config keys mirror the reference: task, data, test:data, model_in,
+model_out, model_dir, num_round, save_period, eval[name]=path, dump_format,
+name_pred, plus any booster/learner parameters.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+from .data.dmatrix import DMatrix
+from .learner import Booster
+from .training import train as _train
+from .utils import console_logger
+
+
+def parse_config_file(path: str) -> List[Tuple[str, str]]:
+    """key=value lines; '#' comments (reference src/common/config.h)."""
+    out: List[Tuple[str, str]] = []
+    with open(path) as f:
+        for line in f:
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            if "=" not in line:
+                raise ValueError(f"bad config line: {line!r}")
+            k, _, v = line.partition("=")
+            out.append((k.strip(), v.strip().strip('"')))
+    return out
+
+
+_CLI_KEYS = {
+    "task", "data", "test:data", "model_in", "model_out", "model_dir",
+    "num_round", "save_period", "dump_format", "name_pred", "name_fmap",
+    "name_dump", "fmap", "with_stats", "iteration_begin", "iteration_end",
+    "silent",
+}
+
+
+def _split_params(pairs: List[Tuple[str, str]]):
+    cli: Dict[str, str] = {}
+    params: Dict[str, Any] = {}
+    evals: List[Tuple[str, str]] = []  # (name, path)
+    for k, v in pairs:
+        if k.startswith("eval[") and k.endswith("]"):
+            evals.append((k[5:-1], v))
+        elif k in _CLI_KEYS:
+            cli[k] = v
+        else:
+            params[k] = v
+    return cli, params, evals
+
+
+def cli_main(argv: List[str]) -> int:
+    if not argv:
+        print(__doc__, file=sys.stderr)
+        return 1
+    pairs = parse_config_file(argv[0])
+    for extra in argv[1:]:
+        k, _, v = extra.partition("=")
+        pairs.append((k, v))
+    cli, params, eval_specs = _split_params(pairs)
+    task = cli.get("task", "train")
+
+    if task == "train":
+        dtrain = DMatrix(cli["data"])
+        evals = [(DMatrix(p), name) for name, p in eval_specs]
+        evals.append((dtrain, "train"))
+        num_round = int(cli.get("num_round", 10))
+        save_period = int(cli.get("save_period", 0))
+        model_dir = cli.get("model_dir", "")
+        callbacks = []
+        if save_period > 0:
+            from .callback import TrainingCheckPoint
+
+            callbacks.append(
+                TrainingCheckPoint(model_dir or ".", name="", interval=save_period)
+            )
+        xgb_model = None
+        if cli.get("model_in"):
+            xgb_model = Booster(params, model_file=cli["model_in"])
+        bst = _train(
+            params, dtrain, num_boost_round=num_round, evals=evals,
+            verbose_eval=not int(cli.get("silent", 0)),
+            xgb_model=xgb_model, callbacks=callbacks,
+        )
+        out = cli.get("model_out", os.path.join(model_dir, f"{num_round:04d}.model")
+                      if model_dir else f"{num_round:04d}.model.json")
+        bst.save_model(out)
+        console_logger.info(f"model saved to {out}")
+    elif task == "dump":
+        bst = Booster(params, model_file=cli["model_in"])
+        fmap = cli.get("name_fmap", cli.get("fmap", ""))
+        dump_format = cli.get("dump_format", "text")
+        with_stats = bool(int(cli.get("with_stats", 0)))
+        out = cli.get("name_dump", "dump.txt")
+        bst.dump_model(out, fmap=fmap, with_stats=with_stats, dump_format=dump_format)
+        console_logger.info(f"dump saved to {out}")
+    elif task == "pred":
+        bst = Booster(params, model_file=cli["model_in"])
+        dtest = DMatrix(cli["test:data"])
+        begin = int(cli.get("iteration_begin", 0))
+        end = int(cli.get("iteration_end", 0))
+        it_range = (begin, end) if (begin, end) != (0, 0) else None
+        preds = bst.predict(dtest, iteration_range=it_range)
+        out = cli.get("name_pred", "pred.txt")
+        np.savetxt(out, np.asarray(preds), fmt="%.9g")
+        console_logger.info(f"predictions saved to {out}")
+    else:
+        print(f"unknown task: {task}", file=sys.stderr)
+        return 1
+    return 0
+
+
+def main() -> None:  # console entry
+    sys.exit(cli_main(sys.argv[1:]))

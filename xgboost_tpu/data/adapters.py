@@ -56,8 +56,18 @@ def _from_pandas(data: Any, missing: float, enable_categorical: bool):
 
 
 def load_svmlight(path: str) -> Tuple[np.ndarray, np.ndarray, Optional[np.ndarray]]:
-    """Minimal libsvm text parser (reference: dmlc-core text parsers used via
-    ``DMatrix::Load``, ``src/data/data.cc``). Returns (X, y, qid)."""
+    """libsvm loader: native C++ parser when available (the dmlc-core
+    analog, ``xgboost_tpu/native/fastparse.cpp``), pure-Python fallback."""
+    from ..native import load_svmlight_native
+
+    res = load_svmlight_native(str(path))
+    if res is not None:
+        return res
+    return _load_svmlight_py(path)
+
+
+def _load_svmlight_py(path: str) -> Tuple[np.ndarray, np.ndarray, Optional[np.ndarray]]:
+    """Pure-Python fallback parser."""
     labels: List[float] = []
     rows: List[int] = []
     cols: List[int] = []
@@ -92,6 +102,12 @@ def load_svmlight(path: str) -> Tuple[np.ndarray, np.ndarray, Optional[np.ndarra
 
 
 def load_csv(path: str, label_column: int = 0) -> Tuple[np.ndarray, np.ndarray]:
+    if label_column == 0:
+        from ..native import load_csv_native
+
+        res = load_csv_native(str(path))
+        if res is not None:
+            return res
     raw = np.loadtxt(path, delimiter=",", dtype=np.float32, ndmin=2)
     y = raw[:, label_column].copy()
     X = np.delete(raw, label_column, axis=1)

@@ -145,16 +145,11 @@ class Booster:
         entry = self._caches.setdefault(id(dtrain), _PredCache())
         self._cache_refs.setdefault(id(dtrain), dtrain)
         n = dtrain.num_row()
-        num_trees = getattr(self._gbm, "model", None)
-        cur = num_trees.num_trees if num_trees is not None else 0
-        if entry.margin is None or entry.num_trees != cur:
+        if self._gbm.name == "dart":
+            # dropout changes old-tree weights: always a fresh dropped pass
             base = self._base_margin_for(dtrain, n)
-            if hasattr(self._gbm, "training_margin"):
-                entry.margin = self._gbm.training_margin(dtrain.data, base)
-            else:
-                entry.margin = self._gbm.predict(dtrain.data, base)
-            entry.num_trees = cur
-        return entry.margin
+            return self._gbm.training_margin(dtrain.data, base)
+        return self._predict_margin(dtrain)
 
     # ------------------------------------------------------------------
     # training
@@ -268,12 +263,49 @@ class Booster:
                 per_round = max(1, self._gbm.n_groups) * self._gbm.gbtree_param.num_parallel_tree
                 tw = tw[lo * per_round : hi * per_round]
             return _pm(sub.stacked(), dmat.data, base, tw)
-        # cache fast path for full-model predictions
+        # cache fast path for full-model predictions, with INCREMENTAL
+        # catch-up: only trees not yet folded into the cache are walked
+        # (reference: gbtree.cc:519 'cache hit? only new trees applied').
+        # DART is excluded — dropout rescales old trees every round.
         entry = self._caches.get(id(dmat))
         cur = self._gbm.model.num_trees if hasattr(self._gbm, "model") else -1
         if entry is not None and entry.margin is not None and entry.num_trees == cur:
             return entry.margin
-        return self._gbm.predict(dmat.data, base)
+        K = self.n_groups
+        per_round = max(1, K) * (
+            self._gbm.gbtree_param.num_parallel_tree
+            if hasattr(self._gbm, "gbtree_param")
+            else 1
+        )
+        if (
+            entry is not None
+            and self._gbm.name == "gbtree"
+            and entry.margin is not None
+            and 0 < entry.num_trees < cur
+            # far behind (e.g. predicting after a long training run with no
+            # intermediate evals): one full pass beats replaying per-round
+            and cur - entry.num_trees <= 16 * per_round
+        ):
+            from .predictor import predict_margin as _pm
+            from .predictor import stack_forest as _sf
+
+            model = self._gbm.model
+            while entry.num_trees < cur:
+                hi = min(entry.num_trees + per_round, cur)
+                sub = _sf(
+                    model.trees[entry.num_trees : hi],
+                    [g for g in model.tree_info[entry.num_trees : hi]],
+                    K,
+                )
+                zero = jnp.zeros((n, K), jnp.float32)
+                entry.margin = entry.margin + _pm(sub, dmat.data, zero)
+                entry.num_trees = hi
+            return entry.margin
+        margin = self._gbm.predict(dmat.data, base)
+        if entry is not None and self._gbm.name == "gbtree":
+            entry.margin = margin
+            entry.num_trees = cur
+        return margin
 
     def predict(
         self,

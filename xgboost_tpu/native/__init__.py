@@ -1,0 +1,131 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+The reference keeps its data loaders, allocators and runtime in C++
+(dmlc-core parsers, src/common/io.cc); the TPU build does the same for the
+host-side pieces that sit outside the XLA compute path. The shared library
+is built on demand with g++ (no pybind11 in the image — plain C ABI).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "fastparse.cpp")
+_LIB_PATH = os.path.join(_HERE, "libfastparse.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-o", _LIB_PATH, _SRC]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except Exception:
+        return False
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native parser; None if unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_LIB_PATH) or (
+            os.path.exists(_SRC)
+            and os.path.getmtime(_SRC) > os.path.getmtime(_LIB_PATH)
+        ):
+            if not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            return None
+        lib.fp_libsvm_dims.argtypes = [
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int32),
+        ]
+        lib.fp_libsvm_dims.restype = ctypes.c_int
+        lib.fp_libsvm_parse.argtypes = (
+            [ctypes.c_char_p] + [ctypes.c_void_p] * 5 + [ctypes.c_int64] * 2
+        )
+        lib.fp_libsvm_parse.restype = ctypes.c_int
+        lib.fp_csv_dims.argtypes = [
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.fp_csv_dims.restype = ctypes.c_int
+        lib.fp_csv_parse.argtypes = [
+            ctypes.c_char_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+        ]
+        lib.fp_csv_parse.restype = ctypes.c_int
+        _lib = lib
+        return _lib
+
+
+def load_svmlight_native(path: str) -> Optional[Tuple[np.ndarray, np.ndarray, Optional[np.ndarray]]]:
+    """Native libsvm load -> (X dense NaN-missing, y, qid|None); None if the
+    native library is unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    n_rows = ctypes.c_int64()
+    n_entries = ctypes.c_int64()
+    max_col = ctypes.c_int64()
+    has_qid = ctypes.c_int32()
+    if lib.fp_libsvm_dims(path.encode(), ctypes.byref(n_rows), ctypes.byref(n_entries),
+                          ctypes.byref(max_col), ctypes.byref(has_qid)) != 0:
+        return None
+    n, e, mc = n_rows.value, n_entries.value, max_col.value
+    rows = np.empty(e, np.int64)
+    cols = np.empty(e, np.int32)
+    vals = np.empty(e, np.float32)
+    labels = np.empty(n, np.float32)
+    qids = np.empty(n, np.int64) if has_qid.value else None
+    rc = lib.fp_libsvm_parse(
+        path.encode(),
+        rows.ctypes.data_as(ctypes.c_void_p),
+        cols.ctypes.data_as(ctypes.c_void_p),
+        vals.ctypes.data_as(ctypes.c_void_p),
+        labels.ctypes.data_as(ctypes.c_void_p),
+        qids.ctypes.data_as(ctypes.c_void_p) if qids is not None else None,
+        n, e,
+    )
+    if rc != 0:
+        return None
+    X = np.full((n, mc + 1 if mc >= 0 else 0), np.nan, np.float32)
+    if e:
+        X[rows, cols] = vals
+    return X, labels, qids
+
+
+def load_csv_native(path: str) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Native CSV load (first column = label) -> (X, y); None if unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    n_rows = ctypes.c_int64()
+    n_cols = ctypes.c_int64()
+    if lib.fp_csv_dims(path.encode(), ctypes.byref(n_rows), ctypes.byref(n_cols)) != 0:
+        return None
+    n, c = n_rows.value, n_cols.value
+    out = np.empty((n, c), np.float32)
+    if lib.fp_csv_parse(path.encode(), out.ctypes.data_as(ctypes.c_void_p), n, c) != 0:
+        return None
+    y = out[:, 0].copy()
+    X = np.ascontiguousarray(out[:, 1:])
+    return X, y

@@ -34,7 +34,10 @@ class StackedForest(NamedTuple):
 
 
 def stack_forest(trees, tree_info, n_groups: int) -> StackedForest:
-    """Pad per-tree SoA arrays to a uniform node count and stack."""
+    """Pad per-tree SoA arrays to a uniform node count and stack. Node and
+    depth dims round up to powers of two so repeated stacking (incremental
+    prediction-cache updates, eval each round) reuses compiled programs
+    instead of recompiling per tree-count."""
     T = len(trees)
     if T == 0:
         z = jnp.zeros((0, 1), jnp.int32)
@@ -46,7 +49,9 @@ def stack_forest(trees, tree_info, n_groups: int) -> StackedForest:
             tree_group=jnp.zeros((0,), jnp.int32), max_depth=1, n_groups=n_groups,
         )
     N = max(t.num_nodes for t in trees)
+    N = 1 << (N - 1).bit_length() if N > 1 else 1
     md = max(max(t.max_depth() for t in trees), 1)
+    md = 1 << (md - 1).bit_length()
 
     def pad(a, fill, dtype):
         out = np.full((T, N), fill, dtype=dtype)

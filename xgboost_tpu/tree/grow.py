@@ -57,7 +57,7 @@ __all__ = [
     "interaction_allowed",
 ]
 
-_INF = jnp.float32(np.inf)
+_INF = float(np.inf)
 
 
 @dataclasses.dataclass(frozen=True)

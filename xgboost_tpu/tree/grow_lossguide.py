@@ -45,7 +45,7 @@ from .param import RT_EPS, calc_weight
 
 __all__ = ["AllocTree", "grow_tree_lossguide"]
 
-_INF = jnp.float32(np.inf)
+_INF = float(np.inf)
 
 
 class AllocTree(NamedTuple):
