@@ -1,0 +1,126 @@
+"""Ranking (LambdaMART) and survival (AFT/Cox) end-to-end tests
+(reference analogs: tests/python/test_ranking.py, test_survival.py)."""
+
+import numpy as np
+import pytest
+
+import xgboost_tpu as xgb
+
+
+def _ranking_data(n_groups=30, group_size=20, f=5, seed=0):
+    rng = np.random.RandomState(seed)
+    n = n_groups * group_size
+    X = rng.randn(n, f).astype(np.float32)
+    # relevance driven by f0 with noise, 3 levels
+    score = X[:, 0] + 0.3 * rng.randn(n)
+    y = np.zeros(n, np.float32)
+    for g in range(n_groups):
+        sl = slice(g * group_size, (g + 1) * group_size)
+        r = np.argsort(np.argsort(-score[sl]))
+        y[sl] = np.where(r < 3, 2.0, np.where(r < 8, 1.0, 0.0))
+    qid = np.repeat(np.arange(n_groups), group_size)
+    return X, y, qid
+
+
+@pytest.mark.parametrize("objective", ["rank:pairwise", "rank:ndcg"])
+def test_ranking_improves_ndcg(objective):
+    X, y, qid = _ranking_data()
+    d = xgb.DMatrix(X, label=y, qid=qid)
+    res = {}
+    bst = xgb.train(
+        {"objective": objective, "max_depth": 3, "eta": 0.3,
+         "eval_metric": ["ndcg@5", "map"]},
+        d, num_boost_round=15, evals=[(d, "train")], evals_result=res,
+        verbose_eval=False,
+    )
+    ndcg = res["train"]["ndcg@5"]
+    assert ndcg[-1] > 0.8
+    assert ndcg[-1] > ndcg[0]
+
+
+def test_ranking_group_param():
+    X, y, qid = _ranking_data(10, 15)
+    d = xgb.DMatrix(X, label=y, group=[15] * 10)
+    bst = xgb.train({"objective": "rank:pairwise", "max_depth": 2},
+                    d, num_boost_round=3, verbose_eval=False)
+    assert bst.num_boosted_rounds() == 3
+
+
+def test_xgbranker_sklearn():
+    from xgboost_tpu.sklearn import XGBRanker
+
+    X, y, qid = _ranking_data(20, 10)
+    r = XGBRanker(n_estimators=5, max_depth=2)
+    r.fit(X, y, qid=qid)
+    s = r.predict(X)
+    assert s.shape == (200,)
+    with pytest.raises(ValueError):
+        XGBRanker(n_estimators=1).fit(X, y)  # no group/qid
+
+
+# ----------------------------------------------------------------- survival
+def test_aft_uncensored_recovers_log_time():
+    rng = np.random.RandomState(0)
+    X = rng.randn(2000, 3).astype(np.float32)
+    t = np.exp(1.0 + 0.8 * X[:, 0] + 0.1 * rng.randn(2000)).astype(np.float32)
+    d = xgb.DMatrix(X, label_lower_bound=t, label_upper_bound=t)
+    res = {}
+    bst = xgb.train(
+        {"objective": "survival:aft", "max_depth": 3, "eta": 0.3,
+         "aft_loss_distribution": "normal", "aft_loss_distribution_scale": 1.0,
+         "eval_metric": "aft-nloglik"},
+        d, num_boost_round=20, evals=[(d, "train")], evals_result=res,
+        verbose_eval=False,
+    )
+    nll = res["train"]["aft-nloglik"]
+    assert nll[-1] < nll[0]
+    pred = bst.predict(d)  # exp(margin) = predicted time
+    corr = np.corrcoef(np.log(pred), np.log(t))[0, 1]
+    assert corr > 0.8
+
+
+def test_aft_right_censored_pushes_up():
+    rng = np.random.RandomState(1)
+    X = rng.randn(1000, 2).astype(np.float32)
+    lower = np.full(1000, 10.0, np.float32)
+    upper = np.full(1000, np.inf, np.float32)  # all right-censored at 10
+    d = xgb.DMatrix(X, label_lower_bound=lower, label_upper_bound=upper)
+    bst = xgb.train({"objective": "survival:aft", "max_depth": 2, "eta": 0.5},
+                    d, num_boost_round=20, verbose_eval=False)
+    pred = bst.predict(d)
+    assert np.median(pred) > 8.0  # predictions pushed above/near the bound
+
+
+def test_interval_regression_accuracy_metric():
+    rng = np.random.RandomState(2)
+    X = rng.randn(500, 2).astype(np.float32)
+    lower = np.exp(rng.randn(500)).astype(np.float32)
+    upper = lower * 2.0
+    d = xgb.DMatrix(X, label_lower_bound=lower, label_upper_bound=upper)
+    res = {}
+    xgb.train(
+        {"objective": "survival:aft", "max_depth": 2,
+         "eval_metric": "interval-regression-accuracy"},
+        d, num_boost_round=10, evals=[(d, "train")], evals_result=res,
+        verbose_eval=False,
+    )
+    acc = res["train"]["interval-regression-accuracy"]
+    assert acc[-1] >= acc[0]
+
+
+def test_cox_orders_risk():
+    rng = np.random.RandomState(3)
+    n = 1000
+    X = rng.randn(n, 3).astype(np.float32)
+    risk = X[:, 0]  # higher risk -> earlier event
+    t = np.exp(-risk + 0.5 * rng.randn(n))
+    order = np.argsort(t)  # cox requires time-ascending sort
+    X, t, risk = X[order], t[order], risk[order]
+    y = t.astype(np.float32)  # all events (no censoring): positive labels
+    d = xgb.DMatrix(X, label=y)
+    bst = xgb.train({"objective": "survival:cox", "max_depth": 2, "eta": 0.3,
+                     "eval_metric": "cox-nloglik"},
+                    d, num_boost_round=15, verbose_eval=False)
+    margin = bst.predict(d, output_margin=True)
+    corr = np.corrcoef(margin, risk)[0, 1]
+    assert corr > 0.6, corr

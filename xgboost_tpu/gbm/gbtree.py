@@ -121,6 +121,7 @@ class GBTree:
         hess: jax.Array,
         iteration: int,
         margin_cache: Optional[jax.Array],  # [n, K] updated in place-ish
+        feature_weights: Optional[jax.Array] = None,
     ) -> Tuple[List[RegTree], Optional[jax.Array]]:
         """One boosting round: K groups x num_parallel_tree new trees.
         Returns (new trees, updated margin cache). The cache update is the
@@ -153,6 +154,11 @@ class GBTree:
                 key = jax.random.PRNGKey(
                     (tp.seed * 1000003 + iteration * 131 + k * 17 + ptree) & 0x7FFFFFFF
                 )
+                fw = (
+                    jnp.asarray(feature_weights)
+                    if feature_weights is not None
+                    else None
+                )
                 if lossguide:
                     from ..tree.grow_lossguide import grow_tree_lossguide
 
@@ -169,7 +175,7 @@ class GBTree:
                     )
                     positions = alloc.positions
                 else:
-                    heap = grow_tree(binned.bins, g, h, cut_vals, key, cfg)
+                    heap = grow_tree(binned.bins, g, h, cut_vals, key, cfg, fw)
                     is_split = np.asarray(heap.is_split)
                     loss_chg = np.asarray(heap.loss_chg)
                     pruned = prune_heap(is_split, loss_chg, tp.gamma)
@@ -300,10 +306,13 @@ class Dart(GBTree):
             return predict_margin(self.model.stacked(), X, base_margin, jnp.asarray(tw))
         return predict_margin(self.model.stacked(), X, base_margin)
 
-    def boost_one_round(self, binned, grad, hess, iteration, margin_cache):
+    def boost_one_round(self, binned, grad, hess, iteration, margin_cache,
+                        feature_weights=None):
         # DART cannot use the incremental cache (dropout changes old trees'
         # weights every round) — reference also disables the cache for DART
-        new_trees, _ = super().boost_one_round(binned, grad, hess, iteration, None)
+        new_trees, _ = super().boost_one_round(
+            binned, grad, hess, iteration, None, feature_weights
+        )
         self._normalize_trees(len(new_trees))
         return new_trees, None
 

@@ -165,6 +165,8 @@ class Booster:
             grad, hess = fobj(pred, dtrain)
             self.boost(dtrain, grad, hess)
             return
+        from .utils import observer
+
         with self.monitor.section("GetGradient"):
             margin = self._cached_margin(dtrain)
             m = margin[:, 0] if self.n_groups == 1 else margin
@@ -178,6 +180,10 @@ class Booster:
                 label_lower=jnp.asarray(info.label_lower_bound) if info.label_lower_bound is not None else None,
                 label_upper=jnp.asarray(info.label_upper_bound) if info.label_upper_bound is not None else None,
             )
+        if observer.enabled():
+            observer.observe("margin", margin, iteration)
+            observer.observe("grad", grad, iteration)
+            observer.observe("hess", hess, iteration)
         self._do_boost(dtrain, grad, hess, iteration)
         self.monitor.maybe_print()
 
@@ -193,9 +199,11 @@ class Booster:
         if self._gbm.name in ("gbtree", "dart"):
             with self.monitor.section("GetBinned"):
                 binned = dtrain.get_binned(self._gbm.train_param.max_bin, dtrain.info.weight)
+            fw = dtrain.info.feature_weights
             with self.monitor.section("BoostOneRound"):
                 _, new_margin = self._gbm.boost_one_round(
-                    binned, grad, hess, iteration, entry.margin
+                    binned, grad, hess, iteration, entry.margin,
+                    feature_weights=fw,
                 )
             if new_margin is not None:
                 entry.margin = new_margin

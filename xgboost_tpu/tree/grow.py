@@ -77,10 +77,14 @@ class GrowParams:
     monotone: Tuple[int, ...] = ()
     # interaction groups as tuples of feature ids (empty = unconstrained)
     interaction: Tuple[Tuple[int, ...], ...] = ()
-    # feature ids treated as categorical (one-hot splits: one category vs
-    # rest — reference's max_cat_to_onehot regime, evaluate_splits.h:61-203;
-    # optimal-partition splits are a planned extension)
+    # feature ids treated as categorical with ONE-HOT splits (one category
+    # vs rest — reference's max_cat_to_onehot regime, evaluate_splits.h)
     categorical: Tuple[int, ...] = ()
+    # feature ids treated as categorical with OPTIMAL-PARTITION splits:
+    # categories sorted by gradient ratio, best prefix becomes the
+    # right-going set (evaluate_splits.h:61-203 partition enum, the
+    # LightGBM-style scan; optimal for convex losses)
+    cat_partition: Tuple[int, ...] = ()
     # name of a mesh axis to psum histograms over (None = single device).
     # This is THE distributed hook: the reference's histogram AllReduce
     # (hist/histogram.h:201, updater_gpu_hist.cu:526) becomes one psum.
@@ -104,11 +108,23 @@ class GrowParams:
 
     @property
     def has_categorical(self) -> bool:
-        return len(self.categorical) > 0
+        return len(self.categorical) > 0 or len(self.cat_partition) > 0
+
+    @property
+    def has_cat_partition(self) -> bool:
+        return len(self.cat_partition) > 0
 
     def cat_mask_np(self, n_features: int) -> np.ndarray:
+        """[F] bool: any-categorical (one-hot or partition)."""
         m = np.zeros(n_features, bool)
-        for f in self.categorical:
+        for f in tuple(self.categorical) + tuple(self.cat_partition):
+            if f < n_features:
+                m[f] = True
+        return m
+
+    def cat_partition_mask_np(self, n_features: int) -> np.ndarray:
+        m = np.zeros(n_features, bool)
+        for f in self.cat_partition:
             if f < n_features:
                 m[f] = True
         return m
@@ -127,12 +143,27 @@ class HeapTree(NamedTuple):
     node_weight: jax.Array  # f32 [max_nodes] pre-eta optimal weight
     loss_chg: jax.Array  # f32 [max_nodes]
     positions: jax.Array  # int32 [n_rows] final heap position of each row
+    # [max_nodes, B] right-going category set per categorical split node
+    # ([1, 1] placeholder when no categorical features)
+    cat_set: jax.Array
 
 
-def _sample_features_exact(key: jax.Array, n_features: int, frac: float) -> jax.Array:
+def _sample_features_exact(
+    key: jax.Array,
+    n_features: int,
+    frac: float,
+    weights: Optional[jax.Array] = None,
+) -> jax.Array:
     """Exact-k without-replacement feature subset (reference:
-    ColumnSampler, src/common/random.h:120)."""
+    ColumnSampler, src/common/random.h:120). With ``weights``
+    (MetaInfo.feature_weights), sampling is probability-proportional via
+    the Gumbel top-k trick."""
     k = max(1, int(round(frac * n_features)))
+    if weights is not None:
+        g = jax.random.gumbel(key, (n_features,))
+        score = jnp.log(jnp.maximum(weights, 1e-30)) + g
+        top = jnp.argsort(-score)[:k]
+        return jnp.zeros((n_features,), bool).at[top].set(True)
     perm = jax.random.permutation(key, n_features)
     return jnp.zeros((n_features,), bool).at[perm[:k]].set(True)
 
@@ -147,6 +178,9 @@ class SplitDecision(NamedTuple):
     GL: jax.Array  # left-child stats of the winner (missing included per dir)
     HL: jax.Array
     w_node: jax.Array  # (bound-clamped) node weight
+    # [K, B] right-going category set of the winner (all-False for
+    # numerical winners); only materialized when categorical features exist
+    cat_set: Optional[jax.Array] = None
 
 
 def eval_splits(
@@ -159,15 +193,20 @@ def eval_splits(
     mono: Optional[jax.Array] = None,  # [F] -1/0/+1
     node_lo: Optional[jax.Array] = None,  # [K] weight bounds
     node_up: Optional[jax.Array] = None,
-    cat_feats: Optional[jax.Array] = None,  # [F] bool: categorical features
+    cat_feats: Optional[jax.Array] = None,  # [F] bool: one-hot categorical
+    cat_part: Optional[jax.Array] = None,  # [F] bool: partition categorical
 ) -> SplitDecision:
     """The ONE split evaluator (used by both depthwise and lossguide growers
     — the reference keeps a single HistEvaluator for the same reason,
     hist/evaluate_splits.h:26). Scans cumulative G/H over bins for both
     missing-direction hypotheses, applies min_child_weight / feature masks /
-    monotone bound clamping, and argmaxes loss_chg per node. Categorical
-    features score one-hot candidates instead: bin b means "category b goes
-    right, the rest left" (evaluate_splits.h one-hot path)."""
+    monotone bound clamping, and argmaxes loss_chg per node.
+
+    Categorical candidates (matching the reference's split enum,
+    evaluate_splits.h:61-203; stored sets go RIGHT per categorical.h
+    Decision): one-hot features score "category b right vs rest left";
+    partition features sort categories by gradient ratio and score every
+    prefix of the sorted order as the right-going set."""
     K, F = hist.shape[0], hist.shape[1]
     g_b, h_b = hist[:, :, :B, 0], hist[:, :, :B, 1]
     g_miss, h_miss = hist[:, :, B, 0], hist[:, :, B, 1]
@@ -176,14 +215,31 @@ def eval_splits(
     # dir 0: missing goes right (default_left=False); dir 1: missing left
     GLd = jnp.stack([GL, GL + g_miss[..., None]], axis=1)  # [K, 2, F, B]
     HLd = jnp.stack([HL, HL + h_miss[..., None]], axis=1)
+    Gp, Hp = GL[..., -1:], HL[..., -1:]  # present-value totals
     if cat_feats is not None:
         # one-hot: left = all-but-category-b (+ missing when default-left)
-        Gp, Hp = GL[..., -1:], HL[..., -1:]  # present-value totals
         GLc = jnp.stack([Gp - g_b, Gp - g_b + g_miss[..., None]], axis=1)
         HLc = jnp.stack([Hp - h_b, Hp - h_b + h_miss[..., None]], axis=1)
         sel = cat_feats[None, None, :, None]
         GLd = jnp.where(sel, GLc, GLd)
         HLd = jnp.where(sel, HLc, HLd)
+    inv_order = None
+    if cat_part is not None:
+        # partition: sort categories by g/(h+lambda); candidate j = first
+        # j+1 sorted categories form the RIGHT side
+        present = (h_b > 0.0) | (g_b != 0.0)
+        ratio = jnp.where(present, g_b / (h_b + p.reg_lambda), jnp.inf)
+        order = jnp.argsort(ratio, axis=-1)  # [K, F, B]
+        inv_order = jnp.argsort(order, axis=-1)  # rank of each bin
+        g_s = jnp.take_along_axis(g_b, order, axis=-1)
+        h_s = jnp.take_along_axis(h_b, order, axis=-1)
+        GRs = jnp.cumsum(g_s, axis=-1)  # right side = sorted prefix
+        HRs = jnp.cumsum(h_s, axis=-1)
+        GLp = jnp.stack([Gp - GRs, Gp - GRs + g_miss[..., None]], axis=1)
+        HLp = jnp.stack([Hp - HRs, Hp - HRs + h_miss[..., None]], axis=1)
+        sel = cat_part[None, None, :, None]
+        GLd = jnp.where(sel, GLp, GLd)
+        HLd = jnp.where(sel, HLp, HLd)
     GRd = Gtot[:, None, None, None] - GLd
     HRd = Htot[:, None, None, None] - HLd
 
@@ -214,14 +270,32 @@ def eval_splits(
     best_loss = jnp.take_along_axis(flat, best_idx[:, None], axis=1)[:, 0]
     FB = F * B
     pick = lambda a: jnp.take_along_axis(a.reshape(K, -1), best_idx[:, None], axis=1)[:, 0]
+    best_f = ((best_idx % FB) // B).astype(jnp.int32)
+    best_b = ((best_idx % FB) % B).astype(jnp.int32)
+
+    cat_set = None
+    if cat_feats is not None or cat_part is not None:
+        iota_b = jnp.arange(B)
+        cat_set = jnp.zeros((K, B), bool)
+        if cat_feats is not None:  # one-hot winner: single-category set
+            oh = iota_b[None, :] == best_b[:, None]
+            cat_set = jnp.where(cat_feats[best_f][:, None], oh, cat_set)
+        if cat_part is not None:  # partition winner: sorted prefix
+            inv_f = jnp.take_along_axis(
+                inv_order, best_f[:, None, None], axis=1
+            )[:, 0, :]  # [K, B] rank of each bin under the winner feature
+            pref = inv_f <= best_b[:, None]
+            cat_set = jnp.where(cat_part[best_f][:, None], pref, cat_set)
+
     return SplitDecision(
         loss=best_loss,
         dir=(best_idx // FB).astype(jnp.int32),
-        f=((best_idx % FB) // B).astype(jnp.int32),
-        b=((best_idx % FB) % B).astype(jnp.int32),
+        f=best_f,
+        b=best_b,
         GL=pick(GLd),
         HL=pick(HLd),
         w_node=w_node,
+        cat_set=cat_set,
     )
 
 
@@ -263,6 +337,7 @@ def grow_tree(
     cut_values: jax.Array,  # [F, max_bin] f32
     key: jax.Array,
     cfg: GrowParams,
+    feature_weights: Optional[jax.Array] = None,  # [F] sampling weights
 ) -> HeapTree:
     n, F = bins.shape
     B = cut_values.shape[1]
@@ -285,7 +360,7 @@ def grow_tree(
 
     # ---- hierarchical column sampling ----
     if cfg.colsample_bytree < 1.0:
-        tree_mask = _sample_features_exact(k_ctree, F, cfg.colsample_bytree)
+        tree_mask = _sample_features_exact(k_ctree, F, cfg.colsample_bytree, feature_weights)
     else:
         tree_mask = jnp.ones((F,), bool)
 
@@ -301,13 +376,24 @@ def grow_tree(
                 if f < F:
                     gmask_np[gi, f] = True
         gmask = jnp.asarray(gmask_np)  # [G, F]
-    cat_j = jnp.asarray(cfg.cat_mask_np(F)) if cfg.has_categorical else None
+    cat_j = None
+    catp_j = None
+    cat_any_j = None
+    if cfg.has_categorical:
+        cat_any_j = jnp.asarray(cfg.cat_mask_np(F))
+        onehot_np = cfg.cat_mask_np(F) & ~cfg.cat_partition_mask_np(F)
+        cat_j = jnp.asarray(onehot_np) if onehot_np.any() else None
+        catp_j = (
+            jnp.asarray(cfg.cat_partition_mask_np(F))
+            if cfg.has_cat_partition
+            else None
+        )
 
     gh = jnp.stack([grad, hess], axis=-1)  # [n, 2]
 
     def body(d: jax.Array, state):
         (pos, is_split, feature, split_bin, split_cond, default_left,
-         node_g, node_h, node_w, loss_chg, lo_b, up_b, used) = state
+         node_g, node_h, node_w, loss_chg, lo_b, up_b, used, cat_set_st) = state
 
         offset = (1 << d) - 1  # first heap id of this level
         width = 1 << d  # real nodes at this level (<= Nmax)
@@ -358,6 +444,7 @@ def grow_tree(
             node_lo=node_lo if cfg.has_monotone else None,
             node_up=node_up if cfg.has_monotone else None,
             cat_feats=cat_j,
+            cat_part=catp_j,
         )
         best_loss, best_dir, best_f, best_b = dec.loss, dec.dir, dec.f, dec.b
         w_node = dec.w_node
@@ -379,6 +466,8 @@ def grow_tree(
         node_h = node_h.at[widx].set(Htot, mode="drop")
         node_w = node_w.at[widx].set(w_node, mode="drop")
         loss_chg = loss_chg.at[widx].set(jnp.where(can_split, best_loss, 0.0), mode="drop")
+        if cfg.has_categorical:
+            cat_set_st = cat_set_st.at[widx].set(dec.cat_set, mode="drop")
 
         # children weights/bounds for the next level
         if cfg.has_monotone:
@@ -416,18 +505,20 @@ def grow_tree(
         missing = bv == B
         present_goleft = bv <= b_of
         if cfg.has_categorical:
-            # categorical one-hot: the split category goes right
-            present_goleft = jnp.where(cat_j[f_of], bv != b_of, present_goleft)
+            # categorical (one-hot or partition): the stored set goes RIGHT
+            in_set = cat_set_st[pos, jnp.minimum(bv, B - 1)]
+            present_goleft = jnp.where(cat_any_j[f_of], ~in_set, present_goleft)
         goleft = jnp.where(missing, dl_of, present_goleft)
         pos = jnp.where(goes, jnp.where(goleft, 2 * pos + 1, 2 * pos + 2), pos)
 
         return (pos, is_split, feature, split_bin, split_cond, default_left,
-                node_g, node_h, node_w, loss_chg, lo_b, up_b, used)
+                node_g, node_h, node_w, loss_chg, lo_b, up_b, used, cat_set_st)
 
     # constraint state tensors are 1-element dummies when unused, so the
     # compiled program carries no overhead for the common case
     n_b = max_nodes if cfg.has_monotone else 1
     n_u = max_nodes if cfg.has_interaction else 1
+    n_cs, b_cs = (max_nodes, B) if cfg.has_categorical else (1, 1)
     init = (
         jnp.zeros((n,), jnp.int32),
         jnp.zeros((max_nodes,), bool),
@@ -442,6 +533,7 @@ def grow_tree(
         jnp.full((n_b,), -_INF),
         jnp.full((n_b,), _INF),
         jnp.zeros((n_u, F), bool),
+        jnp.zeros((n_cs, b_cs), bool),
     )
     if max_depth == 0:
         state = init
@@ -454,18 +546,18 @@ def grow_tree(
             state[0], state[1], state[2], state[3], state[4], state[5],
             state[6].at[0].set(G), state[7].at[0].set(H),
             state[8].at[0].set(calc_weight(G, H, p)), state[9],
-            state[10], state[11], state[12],
+            state[10], state[11], state[12], state[13],
         )
     else:
         state = jax.lax.fori_loop(0, max_depth, body, init)
 
     (pos, is_split, feature, split_bin, split_cond, default_left,
-     node_g, node_h, node_w, loss_chg, _, _, _) = state
+     node_g, node_h, node_w, loss_chg, _, _, _, cat_set_st) = state
     return HeapTree(
         is_split=is_split, feature=feature, split_bin=split_bin,
         split_cond=split_cond, default_left=default_left,
         node_g=node_g, node_h=node_h, node_weight=node_w,
-        loss_chg=loss_chg, positions=pos,
+        loss_chg=loss_chg, positions=pos, cat_set=cat_set_st,
     )
 
 

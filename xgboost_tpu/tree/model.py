@@ -90,12 +90,14 @@ class RegTree:
         eta: float,
         split_bin: Optional[np.ndarray] = None,
         cat_features: Optional[np.ndarray] = None,  # [F] bool
+        cat_set: Optional[np.ndarray] = None,  # [n_heap, B] right-going sets
     ) -> "RegTree":
         """Compact a heap-layout tree (children of heap node i at 2i+1/2i+2)
         into BFS-ordered SoA. ``is_split`` must already be gamma-pruned
         (see ``grow.prune_heap``, the analog of the reference's chained
-        ``updater_prune.cc``). For one-hot categorical splits the node's
-        condition is the category code itself (split_type=1)."""
+        ``updater_prune.cc``). Categorical nodes carry their right-going
+        category set (one-hot: a single code, kept in split_conditions for
+        dump compatibility; partition: the full set in ``categories``)."""
         n_heap = len(is_split)
 
         # BFS over existing heap nodes
@@ -123,6 +125,8 @@ class RegTree:
         lchg = np.zeros(n, np.float32)
         shess = np.zeros(n, np.float32)
         stype = np.zeros(n, np.int8)
+        categories: List[Optional[np.ndarray]] = [None] * n
+        any_cats = False
         for idx, h in enumerate(order):
             bw[idx] = eta * weight[h]
             shess[idx] = sum_hess[h]
@@ -139,7 +143,16 @@ class RegTree:
                 )
                 if is_cat:
                     stype[idx] = 1
-                    scond[idx] = float(split_bin[h])  # the category code
+                    any_cats = True
+                    if cat_set is not None:
+                        cats = np.nonzero(cat_set[h])[0].astype(np.int32)
+                    else:
+                        cats = np.asarray([split_bin[h]], np.int32)
+                    categories[idx] = cats
+                    # single-category (one-hot) nodes keep the code in the
+                    # condition for text dumps; multi-category sets live in
+                    # `categories` only
+                    scond[idx] = float(cats[0]) if len(cats) == 1 else 0.0
                 else:
                     scond[idx] = split_cond[h]
                 dleft[idx] = bool(default_left[h])
@@ -157,6 +170,11 @@ class RegTree:
             loss_changes=lchg,
             sum_hessian=shess,
             split_type=stype,
+            categories=(
+                [c if c is not None else np.empty(0, np.int32) for c in categories]
+                if any_cats
+                else None
+            ),
         )
 
     @classmethod
@@ -271,7 +289,7 @@ class RegTree:
                 if self.split_type[i] == 1 and self.left_children[i] != -1:
                     nodes.append(i)
                     segments.append(len(cats))
-                    if self.categories is not None and i < len(self.categories or []):
+                    if self.categories is not None and len(self.categories[i]) > 0:
                         cs = [int(c) for c in self.categories[i]]
                     else:
                         cs = [int(self.split_conditions[i])]  # one-hot
@@ -328,19 +346,13 @@ class RegTree:
             segs = j.get("categories_segments", [])
             sizes = j.get("categories_sizes", [])
             categories = [np.empty(0, np.int32) for _ in range(n)]
+
             for node, seg, size in zip(cat_nodes, segs, sizes):
                 cs = np.asarray(cats[seg : seg + size], np.int32)
                 categories[node] = cs
                 if size == 1:
-                    # one-hot node: the predictor's equality test keys off
-                    # split_conditions (the category code)
+                    # one-hot node: text dumps key off split_conditions
                     scond[node] = float(cs[0])
-                else:
-                    raise NotImplementedError(
-                        "multi-category (optimal-partition) split sets are "
-                        "not supported yet; this model needs set-membership "
-                        "decisions"
-                    )
         return cls(
             left_children=np.asarray(j["left_children"], np.int32),
             right_children=np.asarray(j["right_children"], np.int32),
@@ -365,7 +377,10 @@ class RegTree:
         if np.isnan(v):
             return self.left_children[i] if self.default_left[i] else self.right_children[i]
         if self.split_type is not None and self.split_type[i] == 1:
-            goleft = v != self.split_conditions[i]  # one-hot: category -> right
+            if self.categories is not None and len(self.categories[i]) > 0:
+                goleft = int(v) not in self.categories[i]  # in set -> right
+            else:
+                goleft = v != self.split_conditions[i]  # one-hot fallback
         else:
             goleft = v < self.split_conditions[i]
         return self.left_children[i] if goleft else self.right_children[i]

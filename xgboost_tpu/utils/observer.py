@@ -1,0 +1,49 @@
+"""TrainingObserver: numeric debugging dumps.
+
+Reference: ``src/common/observer.h:38`` — compile-gated dumps of
+gradients/predictions/trees designed to be diff-able across
+implementations (USE_DEBUG_OUTPUT). Here it is runtime-gated by the
+``XGBTPU_OBSERVER`` env var (set to a directory path) or
+``set_config(observer_dir=...)`` would be overkill — env is enough for a
+debugging tool. Each observed tensor lands as
+``<dir>/<iteration>_<name>.npy`` plus a one-line summary on stderr, so two
+implementations (or two code versions) can be diffed array by array.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Any, Optional
+
+import numpy as np
+
+__all__ = ["observe", "enabled"]
+
+
+def _dir() -> Optional[str]:
+    return os.environ.get("XGBTPU_OBSERVER") or None
+
+
+def enabled() -> bool:
+    return _dir() is not None
+
+
+def observe(name: str, value: Any, iteration: int = 0) -> None:
+    """No-op unless XGBTPU_OBSERVER points at a directory."""
+    d = _dir()
+    if d is None:
+        return
+    os.makedirs(d, exist_ok=True)
+    arr = np.asarray(value)
+    path = os.path.join(d, f"{iteration:05d}_{name}.npy")
+    np.save(path, arr)
+    with np.errstate(all="ignore"):
+        print(
+            f"[observer] it={iteration} {name}: shape={arr.shape} "
+            f"sum={float(arr.astype(np.float64).sum()):.9g} "
+            f"min={float(arr.min()) if arr.size else 0:.6g} "
+            f"max={float(arr.max()) if arr.size else 0:.6g} -> {path}",
+            file=sys.stderr,
+            flush=True,
+        )
