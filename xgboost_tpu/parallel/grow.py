@@ -40,15 +40,17 @@ def distributed_grow_tree(
 
     cfg_dist = dataclasses.replace(cfg, axis_name=ROW_AXIS)
 
+    # Build the out_specs programmatically from HeapTree._fields so the
+    # spec can never drift from the NamedTuple definition: every tree
+    # tensor comes back replicated, only per-row positions stay sharded.
+    out_specs = HeapTree(
+        **{f: (P(ROW_AXIS) if f == "positions" else P()) for f in HeapTree._fields}
+    )
     fn = jax.shard_map(
         partial(grow_tree, cfg=cfg_dist),
         mesh=mesh,
         in_specs=(P(ROW_AXIS, None), P(ROW_AXIS), P(ROW_AXIS), P(None, None), P()),
-        out_specs=HeapTree(
-            is_split=P(), feature=P(), split_bin=P(), split_cond=P(),
-            default_left=P(), node_g=P(), node_h=P(), node_weight=P(),
-            loss_chg=P(), positions=P(ROW_AXIS),
-        ),
+        out_specs=out_specs,
         check_vma=False,
     )
     return fn(bins, grad, hess, cut_values, key)
