@@ -176,6 +176,11 @@ class BinnedMatrix:
 
     # feature ids binned as categorical (identity cuts)
     categorical: Tuple[int, ...] = ()
+    # number of categories per categorical feature (aligned with
+    # ``categorical``): max observed code + 1. Drives the
+    # max_cat_to_onehot one-hot/partition decision (evaluate_splits.h
+    # UseOneHot gate).
+    cat_counts: Tuple[int, ...] = ()
 
     @classmethod
     def from_dense(
@@ -187,6 +192,16 @@ class BinnedMatrix:
         categorical: Optional[Sequence[int]] = None,
     ) -> "BinnedMatrix":
         cat = tuple(categorical) if categorical else ()
+        counts: Tuple[int, ...] = ()
+        if cat:
+            Xn = np.asarray(X)
+            maxes = [
+                np.nanmax(Xn[:, f]) if np.isfinite(Xn[:, f]).any() else np.nan
+                for f in cat
+            ]
+            counts = tuple(
+                int(m) + 1 if np.isfinite(m) else 1 for m in maxes
+            )
         if cuts is None:
             cuts = compute_cuts(X, max_bin=max_bin, weights=weights, categorical=cat)
-        return cls(cuts=cuts, bins=bin_matrix(X, cuts), categorical=cat)
+        return cls(cuts=cuts, bins=bin_matrix(X, cuts), categorical=cat, cat_counts=counts)

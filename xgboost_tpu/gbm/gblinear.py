@@ -36,10 +36,13 @@ def _linear_round(
     Xz = jnp.nan_to_num(X)
     mask = (~jnp.isnan(X)).astype(X.dtype)
 
-    # bias update first (reference: gblinear.cc updates bias via sum g / sum h)
+    # bias update first (reference: gblinear.cc updates bias via sum g / sum h;
+    # residuals advance by the APPLIED delta eta*db, coordinate_common.h
+    # UpdateResidualParallel with dbias)
     db = -grad.sum() / jnp.maximum(hess.sum(), 1e-10)
-    weights = weights.at[-1].add(eta * db)
-    grad = grad + hess * db * 1.0
+    db_applied = eta * db
+    weights = weights.at[-1].add(db_applied)
+    grad = grad + hess * db_applied
 
     if cyclic:
         def body(f, carry):

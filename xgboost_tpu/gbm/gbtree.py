@@ -28,8 +28,9 @@ from ..utils import console_logger
 class GBTreeModel:
     """Tree collection + group ids (reference: ``src/gbm/gbtree_model.h``)."""
 
-    def __init__(self, n_groups: int = 1):
+    def __init__(self, n_groups: int = 1, num_parallel_tree: int = 1):
         self.n_groups = n_groups
+        self.num_parallel_tree = max(1, num_parallel_tree)
         self.trees: List[RegTree] = []
         self.tree_info: List[int] = []
         self._stacked: Optional[StackedForest] = None
@@ -49,10 +50,11 @@ class GBTreeModel:
         return self._stacked
 
     def slice(self, begin: int, end: int, step: int = 1) -> "GBTreeModel":
-        out = GBTreeModel(self.n_groups)
+        out = GBTreeModel(self.n_groups, self.num_parallel_tree)
         # layered slicing: rounds -> trees_per_round trees (gbtree slicing
-        # semantics operate on boosting rounds)
-        per_round = max(1, self.n_groups)
+        # semantics operate on boosting rounds; one round appends
+        # n_groups * num_parallel_tree trees — gbtree.cc:326)
+        per_round = max(1, self.n_groups) * self.num_parallel_tree
         for r in range(begin, end, step):
             for t in range(r * per_round, min((r + 1) * per_round, len(self.trees))):
                 out.add(self.trees[t], self.tree_info[t])
@@ -71,7 +73,7 @@ class GBTree:
         rest = self.gbtree_param.update(dict(params))
         self.train_param = TrainParam()
         self.train_param.update(rest)
-        self.model = GBTreeModel(self.n_groups)
+        self.model = GBTreeModel(self.n_groups, self.gbtree_param.num_parallel_tree)
         self._configure_method()
 
     def _configure_method(self) -> None:
@@ -129,8 +131,14 @@ class GBTree:
         final grower position, no predictor pass (gbtree.cc:219)."""
         tp = self.train_param
         cfg = self._grow_params()
-        if getattr(binned, "categorical", ()):
-            cfg = _dc.replace(cfg, categorical=tuple(binned.categorical))
+        cats = tuple(getattr(binned, "categorical", ()))
+        if cats:
+            # one-hot vs optimal-partition gate (reference UseOneHot,
+            # evaluate_splits.h: one-hot when n_cats < max_cat_to_onehot)
+            counts = tuple(getattr(binned, "cat_counts", ())) or (0,) * len(cats)
+            onehot = tuple(f for f, c in zip(cats, counts) if c < tp.max_cat_to_onehot)
+            part = tuple(f for f, c in zip(cats, counts) if c >= tp.max_cat_to_onehot)
+            cfg = _dc.replace(cfg, categorical=onehot, cat_partition=part)
         cat_mask = cfg.cat_mask_np(binned.n_features) if cfg.has_categorical else None
         cuts = binned.cuts
         cut_vals = jnp.asarray(cuts.values)
@@ -163,7 +171,7 @@ class GBTree:
                     from ..tree.grow_lossguide import grow_tree_lossguide
 
                     alloc = grow_tree_lossguide(
-                        binned.bins, g, h, cut_vals, key, cfg, max_leaves
+                        binned.bins, g, h, cut_vals, key, cfg, max_leaves, fw
                     )
                     tree, lmap_np = RegTree.from_alloc(
                         np.asarray(alloc.left), np.asarray(alloc.right),
@@ -172,6 +180,9 @@ class GBTree:
                         np.asarray(alloc.loss_chg), np.asarray(alloc.node_h),
                         int(alloc.n_nodes), eta=tp.eta, min_split_loss=tp.gamma,
                         split_bin=np.asarray(alloc.split_bin), cat_features=cat_mask,
+                        cat_set=(
+                            np.asarray(alloc.cat_set) if cfg.has_categorical else None
+                        ),
                     )
                     positions = alloc.positions
                 else:
@@ -190,6 +201,9 @@ class GBTree:
                         eta=tp.eta,
                         split_bin=np.asarray(heap.split_bin),
                         cat_features=cat_mask,
+                        cat_set=(
+                            np.asarray(heap.cat_set) if cfg.has_categorical else None
+                        ),
                     )
                     lmap_np = leaf_value_map(pruned, np.asarray(heap.node_weight), tp.eta)
                     positions = heap.positions
@@ -234,7 +248,7 @@ class GBTree:
 
     def load_json(self, j: dict) -> None:
         m = j["model"]
-        self.model = GBTreeModel(self.n_groups)
+        self.model = GBTreeModel(self.n_groups, self.gbtree_param.num_parallel_tree)
         for tj, info in zip(m["trees"], m["tree_info"]):
             self.model.add(RegTree.from_json(tj), int(info))
 

@@ -88,9 +88,7 @@ def _tree_shap(tree, x: np.ndarray, phi: np.ndarray, node: int, path: List[_Path
     if np.isnan(v):
         hot = tree.left_children[node] if tree.default_left[node] else tree.right_children[node]
     else:
-        is_cat = tree.split_type is not None and tree.split_type[node] == 1
-        goleft = (v != tree.split_conditions[node]) if is_cat else (v < tree.split_conditions[node])
-        hot = tree.left_children[node] if goleft else tree.right_children[node]
+        hot = tree.left_children[node] if tree.goes_left(node, v) else tree.right_children[node]
     cold = (
         tree.right_children[node]
         if hot == tree.left_children[node]
@@ -142,9 +140,7 @@ def _saabas(tree, x: np.ndarray, phi: np.ndarray) -> None:
         if np.isnan(v):
             nxt = tree.left_children[i] if tree.default_left[i] else tree.right_children[i]
         else:
-            is_cat = tree.split_type is not None and tree.split_type[i] == 1
-            goleft = (v != tree.split_conditions[i]) if is_cat else (v < tree.split_conditions[i])
-            nxt = tree.left_children[i] if goleft else tree.right_children[i]
+            nxt = tree.left_children[i] if tree.goes_left(i, v) else tree.right_children[i]
         nv = node_value(nxt)
         phi[f] += nv - cur
         cur = nv

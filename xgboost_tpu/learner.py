@@ -331,7 +331,11 @@ class Booster:
     ) -> np.ndarray:
         self._configure()
         if ntree_limit and iteration_range is None:
-            per_round = max(1, self.n_groups)
+            per_round = max(1, self.n_groups) * (
+                self._gbm.gbtree_param.num_parallel_tree
+                if hasattr(self._gbm, "gbtree_param")
+                else 1
+            )
             iteration_range = (0, max(1, ntree_limit // per_round))
         if pred_leaf:
             leaves = self._gbm.predict_leaf(data.data)

@@ -119,11 +119,12 @@ class GammaNLogLik(ElementwiseMetric):
     name = "gamma-nloglik"
 
     def loss(self, p, y):
-        # fixed shape psi=1 as the reference
+        # fixed shape psi=1 as the reference (elementwise_metric.cu
+        # EvalGammaNLogLik): theta = -1/p, b(theta) = -log(-theta) = log p,
+        # c(y, psi=1) = log(y)/psi - log(y) - lgamma(1) = 0, so
+        # nloglik = -((y*theta - b)/psi + c) = y/p + log(p)
         p = jnp.maximum(p, _EPS)
-        theta = -1.0 / p
-        a = theta * y - jnp.log(-theta)
-        return -(a - (jnp.log(jnp.maximum(y, _EPS)) + 0.0))  # psi=1 => c = -log y ...
+        return y / p + jnp.log(p)
 
     def finalize(self, s, w):
         return s / w if w > 0 else float("nan")

@@ -27,7 +27,12 @@ class StackedForest(NamedTuple):
     feature: jax.Array  # int32 [T, N]
     cond: jax.Array  # f32 [T, N] (leaf value at leaves)
     default_left: jax.Array  # bool [T, N]
-    split_type: jax.Array  # bool [T, N] (True = one-hot categorical node)
+    split_type: jax.Array  # bool [T, N] (True = categorical node)
+    # per-node right-going category bitset (reference: split_categories
+    # bitsets, tree_model.h:442 / common/bitfield.h CatBitField). W words of
+    # 32 categories; all-zero single word when the forest has no
+    # categorical splits. Covers one-hot AND optimal-partition nodes.
+    cat_bits: jax.Array  # uint32 [T, N, W]
     tree_group: jax.Array  # int32 [T]
     max_depth: int  # static walk bound
     n_groups: int
@@ -46,6 +51,7 @@ def stack_forest(trees, tree_info, n_groups: int) -> StackedForest:
             cond=jnp.zeros((0, 1), jnp.float32),
             default_left=jnp.zeros((0, 1), bool),
             split_type=jnp.zeros((0, 1), bool),
+            cat_bits=jnp.zeros((0, 1, 1), jnp.uint32),
             tree_group=jnp.zeros((0,), jnp.int32), max_depth=1, n_groups=n_groups,
         )
     N = max(t.num_nodes for t in trees)
@@ -60,6 +66,35 @@ def stack_forest(trees, tree_info, n_groups: int) -> StackedForest:
             out[i, : len(v)] = v
         return out
 
+    # ---- category bitsets ----
+    max_cat = 0  # highest category id appearing in any node set
+    for t in trees:
+        if t.split_type is not None and t.categories is not None:
+            for i in np.nonzero(t.split_type)[0]:
+                cs = t.categories[i]
+                if cs is not None and len(cs):
+                    max_cat = max(max_cat, int(cs.max()))
+        elif t.split_type is not None and t.split_type.any():
+            # one-hot nodes without a categories list key off split_conditions
+            oh = t.split_conditions[(t.split_type == 1) & (t.left_children != -1)]
+            if len(oh):
+                max_cat = max(max_cat, int(oh.max()))
+    W = max(1, -(-(max_cat + 1) // 32))
+    W = 1 << (W - 1).bit_length()  # pow2 padding for compile reuse
+    cat_bits = np.zeros((T, N, W), np.uint32)
+    for ti, t in enumerate(trees):
+        if t.split_type is None or not t.split_type.any():
+            continue
+        for i in np.nonzero((t.split_type == 1) & (t.left_children != -1))[0]:
+            if t.categories is not None and len(t.categories[i]):
+                cs = np.asarray(t.categories[i], np.int64)
+            else:
+                cs = np.asarray([int(t.split_conditions[i])], np.int64)
+            cs = cs[(cs >= 0) & (cs < W * 32)]
+            np.bitwise_or.at(
+                cat_bits[ti, i], cs // 32, np.uint32(1) << (cs % 32).astype(np.uint32)
+            )
+
     return StackedForest(
         left=jnp.asarray(pad(lambda t: t.left_children, -1, np.int32)),
         right=jnp.asarray(pad(lambda t: t.right_children, -1, np.int32)),
@@ -70,6 +105,7 @@ def stack_forest(trees, tree_info, n_groups: int) -> StackedForest:
             lambda t: (t.split_type if t.split_type is not None
                        else np.zeros(t.num_nodes, np.int8)).astype(bool),
             False, bool)),
+        cat_bits=jnp.asarray(cat_bits),
         tree_group=jnp.asarray(np.asarray(tree_info, np.int32)),
         max_depth=md,
         n_groups=n_groups,
@@ -81,39 +117,48 @@ def _walk_leaves(
     X: jax.Array,  # [n, F] f32 with NaN missing
     left: jax.Array, right: jax.Array, feature: jax.Array,
     cond: jax.Array, default_left: jax.Array, split_type: jax.Array,
+    cat_bits: jax.Array,  # uint32 [T, N, W]
     max_depth: int,
 ) -> jax.Array:
     """Leaf index of every (tree, row): returns int32 [T, n]. Numerical
-    nodes: left iff v < cond; one-hot categorical nodes: the split category
-    goes right (predict_fn.h / common/categorical.h decision)."""
+    nodes: left iff v < cond; categorical nodes (one-hot or partition): the
+    node's category bitset goes RIGHT (predict_fn.h / common/categorical.h
+    Decision; out-of-range or unseen categories are not in the set, so they
+    go left — matching the reference's bitset bounds check)."""
     n = X.shape[0]
+    W = cat_bits.shape[-1]
 
-    def one_tree(lc, rc, fi, co, dl, st):
+    def one_tree(lc, rc, fi, co, dl, st, cb):
         pos = jnp.zeros((n,), jnp.int32)
 
         def body(_, pos):
             leaf = lc[pos] == -1
             f = fi[pos]
             v = jnp.take_along_axis(X, f[:, None], axis=1)[:, 0]
-            present = jnp.where(st[pos], v != co[pos], v < co[pos])
+            vi = v.astype(jnp.int32)
+            in_range = (vi >= 0) & (vi < W * 32)
+            word = cb[pos, jnp.clip(vi >> 5, 0, W - 1)]
+            bit = (word >> (vi & 31).astype(jnp.uint32)) & jnp.uint32(1)
+            in_set = in_range & (bit == 1)
+            present = jnp.where(st[pos], ~in_set, v < co[pos])
             goleft = jnp.where(jnp.isnan(v), dl[pos], present)
             nxt = jnp.where(goleft, lc[pos], rc[pos])
             return jnp.where(leaf, pos, nxt)
 
         return jax.lax.fori_loop(0, max_depth, body, pos)
 
-    return jax.vmap(one_tree)(left, right, feature, cond, default_left, split_type)
+    return jax.vmap(one_tree)(left, right, feature, cond, default_left, split_type, cat_bits)
 
 
 @partial(jax.jit, static_argnames=("n_groups", "max_depth"))
 def _predict_margin_kernel(
     X: jax.Array,
-    left, right, feature, cond, default_left, split_type, tree_group,
+    left, right, feature, cond, default_left, split_type, cat_bits, tree_group,
     tree_weights: jax.Array,  # f32 [T] (DART scaling; ones otherwise)
     base_margin: jax.Array,  # [n, n_groups]
     n_groups: int, max_depth: int,
 ) -> jax.Array:
-    leaves = _walk_leaves(X, left, right, feature, cond, default_left, split_type, max_depth)  # [T, n]
+    leaves = _walk_leaves(X, left, right, feature, cond, default_left, split_type, cat_bits, max_depth)  # [T, n]
     leaf_vals = jnp.take_along_axis(cond, leaves, axis=1) * tree_weights[:, None]  # [T, n]
     # sum per output group (multiclass: one tree per class per round,
     # reference gbtree.cc:219 gradient slicing)
@@ -138,7 +183,8 @@ def predict_margin(
     return _predict_margin_kernel(
         jnp.asarray(X, jnp.float32),
         forest.left, forest.right, forest.feature, forest.cond,
-        forest.default_left, forest.split_type, forest.tree_group, tw,
+        forest.default_left, forest.split_type, forest.cat_bits,
+        forest.tree_group, tw,
         base_margin, forest.n_groups, forest.max_depth,
     )
 
@@ -150,6 +196,7 @@ def predict_leaf(forest: StackedForest, X: jax.Array) -> jax.Array:
     leaves = _walk_leaves(
         jnp.asarray(X, jnp.float32),
         forest.left, forest.right, forest.feature, forest.cond,
-        forest.default_left, forest.split_type, forest.max_depth,
+        forest.default_left, forest.split_type, forest.cat_bits,
+        forest.max_depth,
     )
     return leaves.T

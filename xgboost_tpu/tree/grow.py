@@ -129,6 +129,20 @@ class GrowParams:
                 m[f] = True
         return m
 
+    def cat_masks_jnp(self, n_features: int):
+        """(any, one-hot, partition) [F] device masks for eval_splits —
+        shared by both growers so the one-hot/partition rule can't diverge.
+        one-hot and partition come back as None when their set is empty."""
+        any_j = jnp.asarray(self.cat_mask_np(n_features))
+        onehot_np = self.cat_mask_np(n_features) & ~self.cat_partition_mask_np(n_features)
+        oh_j = jnp.asarray(onehot_np) if onehot_np.any() else None
+        part_j = (
+            jnp.asarray(self.cat_partition_mask_np(n_features))
+            if self.has_cat_partition
+            else None
+        )
+        return any_j, oh_j, part_j
+
 
 class HeapTree(NamedTuple):
     """Heap-layout tree tensors produced on device."""
@@ -380,14 +394,7 @@ def grow_tree(
     catp_j = None
     cat_any_j = None
     if cfg.has_categorical:
-        cat_any_j = jnp.asarray(cfg.cat_mask_np(F))
-        onehot_np = cfg.cat_mask_np(F) & ~cfg.cat_partition_mask_np(F)
-        cat_j = jnp.asarray(onehot_np) if onehot_np.any() else None
-        catp_j = (
-            jnp.asarray(cfg.cat_partition_mask_np(F))
-            if cfg.has_cat_partition
-            else None
-        )
+        cat_any_j, cat_j, catp_j = cfg.cat_masks_jnp(F)
 
     gh = jnp.stack([grad, hess], axis=-1)  # [n, 2]
 

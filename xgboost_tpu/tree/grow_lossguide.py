@@ -63,6 +63,9 @@ class AllocTree(NamedTuple):
     loss_chg: jax.Array  # f32 [M]
     n_nodes: jax.Array  # int32 scalar
     positions: jax.Array  # int32 [n]
+    # [M, B] right-going category set per categorical split node
+    # ([1, 1] placeholder when no categorical features)
+    cat_set: jax.Array
 
 
 @partial(jax.jit, static_argnames=("cfg", "max_leaves"))
@@ -74,6 +77,7 @@ def grow_tree_lossguide(
     key: jax.Array,
     cfg: GrowParams,
     max_leaves: int,
+    feature_weights: Optional[jax.Array] = None,  # [F] sampling weights
 ) -> AllocTree:
     n, F = bins.shape
     B = cut_values.shape[1]
@@ -89,7 +93,9 @@ def grow_tree_lossguide(
         grad = jnp.where(keep, grad, 0.0)
         hess = jnp.where(keep, hess, 0.0)
     if cfg.colsample_bytree < 1.0:
-        tree_fmask = _sample_features_exact(k_ctree, F, cfg.colsample_bytree)
+        tree_fmask = _sample_features_exact(
+            k_ctree, F, cfg.colsample_bytree, feature_weights
+        )
     else:
         tree_fmask = jnp.ones((F,), bool)
 
@@ -104,7 +110,11 @@ def grow_tree_lossguide(
                 if f < F:
                     gmask_np[gi, f] = True
         gmask = jnp.asarray(gmask_np)
-    cat_j = jnp.asarray(cfg.cat_mask_np(F)) if cfg.has_categorical else None
+    cat_oh_j = None
+    catp_j = None
+    cat_any_j = None
+    if cfg.has_categorical:
+        cat_any_j, cat_oh_j, catp_j = cfg.cat_masks_jnp(F)
 
     gh = jnp.stack([grad, hess], axis=-1)
     gh_full = jnp.broadcast_to(gh[:, None, :], (n, F, 2)).reshape(-1, 2)
@@ -163,6 +173,9 @@ def grow_tree_lossguide(
     lo_b = jnp.full((n_mb,), -_INF)
     up_b = jnp.full((n_mb,), _INF)
     used = jnp.zeros((n_mu, F), bool)
+    n_cs, b_cs = (M, B) if cfg.has_categorical else (1, 1)
+    cand_cat = jnp.zeros((n_cs, b_cs), bool)  # best candidate's category set
+    cat_set = jnp.zeros((n_cs, b_cs), bool)  # committed split sets
 
     # ---- root ----
     pos = jnp.zeros((n,), jnp.int32)
@@ -175,7 +188,8 @@ def grow_tree_lossguide(
         mono=mono_j if cfg.has_monotone else None,
         node_lo=lo_b[:1] if cfg.has_monotone else None,
         node_up=up_b[:1] if cfg.has_monotone else None,
-        cat_feats=cat_j,
+        cat_feats=cat_oh_j,
+        cat_part=catp_j,
     )
     node_g = node_g.at[0].set(G0)
     node_h = node_h.at[0].set(H0)
@@ -186,12 +200,14 @@ def grow_tree_lossguide(
     cand_b = cand_b.at[0].set(dec0.b[0])
     cand_gl = cand_gl.at[0].set(dec0.GL[0])
     cand_hl = cand_hl.at[0].set(dec0.HL[0])
+    if cfg.has_categorical:
+        cand_cat = cand_cat.at[0].set(dec0.cat_set[0])
 
     def body(t, state):
         (pos, left, right, feature, split_bin, split_cond, default_left,
          node_g, node_h, node_w, loss_chg, depth,
-         cand_gain, cand_dir, cand_f, cand_b, cand_gl, cand_hl,
-         lo_b, up_b, used, n_alloc) = state
+         cand_gain, cand_dir, cand_f, cand_b, cand_gl, cand_hl, cand_cat,
+         lo_b, up_b, used, cat_set, n_alloc) = state
 
         # ---- pop best candidate (driver.h lossguide queue) ----
         pick = jnp.argmax(cand_gain)
@@ -213,6 +229,8 @@ def grow_tree_lossguide(
         default_left = default_left.at[w_pick].set(dr == 1, mode="drop")
         loss_chg = loss_chg.at[w_pick].set(gain, mode="drop")
         cand_gain = cand_gain.at[w_pick].set(-jnp.inf, mode="drop")  # no longer a leaf
+        if cfg.has_categorical:
+            cat_set = cat_set.at[w_pick].set(cand_cat[pick], mode="drop")
 
         # children weights + monotone bounds via the shared helper
         if cfg.has_monotone:
@@ -246,7 +264,9 @@ def grow_tree_lossguide(
         bv = bins32[:, f]
         present = bv <= b
         if cfg.has_categorical:
-            present = jnp.where(cat_j[f], bv != b, present)
+            # the stored category set goes RIGHT (categorical.h Decision)
+            in_set = cand_cat[pick, jnp.minimum(bv, B - 1)]
+            present = jnp.where(cat_any_j[f], ~in_set, present)
         goleft = jnp.where(bv == B, dr == 1, present)
         at_pick = (pos == pick) & do
         pos = jnp.where(at_pick, jnp.where(goleft, l_id, r_id), pos)
@@ -269,7 +289,8 @@ def grow_tree_lossguide(
             mono=mono_j if cfg.has_monotone else None,
             node_lo=jnp.stack([l_lo, r_lo]) if cfg.has_monotone else None,
             node_up=jnp.stack([l_up, r_up]) if cfg.has_monotone else None,
-            cat_feats=cat_j,
+            cat_feats=cat_oh_j,
+            cat_part=catp_j,
         )
         bl = dec.loss
         if max_depth > 0:
@@ -280,24 +301,28 @@ def grow_tree_lossguide(
         cand_b = cand_b.at[w_l].set(dec.b[0], mode="drop").at[w_r].set(dec.b[1], mode="drop")
         cand_gl = cand_gl.at[w_l].set(dec.GL[0], mode="drop").at[w_r].set(dec.GL[1], mode="drop")
         cand_hl = cand_hl.at[w_l].set(dec.HL[0], mode="drop").at[w_r].set(dec.HL[1], mode="drop")
+        if cfg.has_categorical:
+            cand_cat = cand_cat.at[w_l].set(dec.cat_set[0], mode="drop")
+            cand_cat = cand_cat.at[w_r].set(dec.cat_set[1], mode="drop")
 
         n_alloc = jnp.where(do, n_alloc + 2, n_alloc)
         return (pos, left, right, feature, split_bin, split_cond, default_left,
                 node_g, node_h, node_w, loss_chg, depth,
-                cand_gain, cand_dir, cand_f, cand_b, cand_gl, cand_hl,
-                lo_b, up_b, used, n_alloc)
+                cand_gain, cand_dir, cand_f, cand_b, cand_gl, cand_hl, cand_cat,
+                lo_b, up_b, used, cat_set, n_alloc)
 
     state = (pos, left, right, feature, split_bin, split_cond, default_left,
              node_g, node_h, node_w, loss_chg, depth,
-             cand_gain, cand_dir, cand_f, cand_b, cand_gl, cand_hl,
-             lo_b, up_b, used, jnp.int32(1))
+             cand_gain, cand_dir, cand_f, cand_b, cand_gl, cand_hl, cand_cat,
+             lo_b, up_b, used, cat_set, jnp.int32(1))
     state = jax.lax.fori_loop(0, max_leaves - 1, body, state)
     (pos, left, right, feature, split_bin, split_cond, default_left,
      node_g, node_h, node_w, loss_chg, depth, *_rest) = state
     n_alloc = state[-1]
+    cat_set = state[-2]
     return AllocTree(
         left=left, right=right, feature=feature, split_bin=split_bin,
         split_cond=split_cond, default_left=default_left,
         node_g=node_g, node_h=node_h, node_weight=node_w,
-        loss_chg=loss_chg, n_nodes=n_alloc, positions=pos,
+        loss_chg=loss_chg, n_nodes=n_alloc, positions=pos, cat_set=cat_set,
     )

@@ -193,6 +193,7 @@ class RegTree:
         min_split_loss: float = 0.0,
         split_bin: Optional[np.ndarray] = None,
         cat_features: Optional[np.ndarray] = None,
+        cat_set: Optional[np.ndarray] = None,  # [M, B] right-going sets
     ) -> Tuple["RegTree", np.ndarray]:
         """Build from allocation-ordered arrays (lossguide grower output),
         applying gamma pruning (updater_prune.cc analog) and compacting via
@@ -248,6 +249,8 @@ class RegTree:
         lchg = np.zeros(nn, np.float32)
         shess = np.zeros(nn, np.float32)
         stype = np.zeros(nn, np.int8)
+        categories: List[Optional[np.ndarray]] = [None] * nn
+        any_cats = False
         for idx, i in enumerate(order):
             bw[idx] = eta * weight[i]
             shess[idx] = sum_hess[i]
@@ -264,7 +267,15 @@ class RegTree:
                 )
                 if is_cat:
                     stype[idx] = 1
-                    scond[idx] = float(split_bin[i])
+                    any_cats = True
+                    if cat_set is not None:
+                        cats = np.nonzero(cat_set[i])[0].astype(np.int32)
+                        if len(cats) == 0:
+                            cats = np.asarray([split_bin[i]], np.int32)
+                    else:
+                        cats = np.asarray([split_bin[i]], np.int32)
+                    categories[idx] = cats
+                    scond[idx] = float(cats[0]) if len(cats) == 1 else 0.0
                 else:
                     scond[idx] = split_cond[i]
                 dleft[idx] = bool(default_left[i])
@@ -276,6 +287,11 @@ class RegTree:
             split_indices=sidx, split_conditions=scond, default_left=dleft,
             base_weights=bw, loss_changes=lchg, sum_hessian=shess,
             split_type=stype,
+            categories=(
+                [c if c is not None else np.empty(0, np.int32) for c in categories]
+                if any_cats
+                else None
+            ),
         )
         return tree, leaf_val
 
@@ -370,20 +386,21 @@ class RegTree:
     # ------------------------------------------------------------------
     # host reference predict (oracle for the XLA predictor) + dumps
     # ------------------------------------------------------------------
+    def goes_left(self, i: int, v: float) -> bool:
+        """Decision for a PRESENT value at node i (reference: predict_fn.h
+        GetNextNode + categorical Decision, common/categorical.h — the
+        stored category set goes right)."""
+        if self.split_type is not None and self.split_type[i] == 1:
+            if self.categories is not None and len(self.categories[i]) > 0:
+                return int(v) not in self.categories[i]  # in set -> right
+            return v != self.split_conditions[i]  # one-hot fallback
+        return v < self.split_conditions[i]
+
     def _next(self, i: int, x: np.ndarray) -> int:
-        """One decision step (reference: predict_fn.h GetNextNode +
-        categorical Decision, common/categorical.h)."""
         v = x[self.split_indices[i]]
         if np.isnan(v):
             return self.left_children[i] if self.default_left[i] else self.right_children[i]
-        if self.split_type is not None and self.split_type[i] == 1:
-            if self.categories is not None and len(self.categories[i]) > 0:
-                goleft = int(v) not in self.categories[i]  # in set -> right
-            else:
-                goleft = v != self.split_conditions[i]  # one-hot fallback
-        else:
-            goleft = v < self.split_conditions[i]
-        return self.left_children[i] if goleft else self.right_children[i]
+        return self.left_children[i] if self.goes_left(i, v) else self.right_children[i]
 
     def predict_one(self, x: np.ndarray) -> float:
         i = 0
