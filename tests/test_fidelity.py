@@ -75,3 +75,17 @@ def test_ntree_limit_respects_num_parallel_tree():
         bst.predict(d, ntree_limit=6, output_margin=True),
         bst.predict(d, iteration_range=(0, 2), output_margin=True),
     )
+
+
+def test_num_parallel_tree_survives_json_round_trip():
+    rng = np.random.RandomState(2)
+    X = rng.randn(300, 4).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    d = xgb.DMatrix(X, label=y)
+    bst = xgb.train({"objective": "binary:logistic", "num_parallel_tree": 3,
+                     "max_depth": 2, "subsample": 0.7},
+                    d, num_boost_round=4, verbose_eval=False)
+    bst.save_model("/tmp/npt.json")
+    b2 = xgb.Booster(model_file="/tmp/npt.json")
+    assert b2.num_boosted_rounds() == 4
+    assert b2[1:3]._gbm.model.num_trees == 6

@@ -193,9 +193,42 @@ class DMatrix:
             cat = self.categorical_features()
             if cat:
                 self._validate_categorical(cat, max_bin)
+            cuts = None
+            from ..parallel.mesh import current_mesh
+
+            mesh = current_mesh()
+            if mesh is not None and mesh.devices.size > 1:
+                # distributed sketch: per-shard summaries merged by
+                # all_gather (the quantile.cc:270 AllReduce site)
+                import jax.numpy as jnp
+
+                from ..parallel.mesh import pad_to_multiple, shard_rows
+                from ..parallel.sketch import distributed_compute_cuts
+
+                X = np.asarray(self._data, np.float32)
+                n_pad = pad_to_multiple(X.shape[0], mesh.devices.size)
+                if n_pad != X.shape[0]:
+                    X = np.concatenate(
+                        [X, np.full((n_pad - X.shape[0], X.shape[1]), np.nan, np.float32)]
+                    )
+                w = sketch_weights
+                if w is not None and len(w):
+                    w = np.concatenate(
+                        [np.asarray(w, np.float32),
+                         np.zeros(n_pad - len(w), np.float32)]
+                    )
+                    w = shard_rows(jnp.asarray(w), mesh)
+                cuts = distributed_compute_cuts(
+                    mesh, shard_rows(jnp.asarray(X), mesh), max_bin=max_bin,
+                    weights=w,
+                )
+                if cat:
+                    from .quantile import apply_categorical_identity
+
+                    apply_categorical_identity(cuts.values, cuts.min_vals, cat)
             bm = BinnedMatrix.from_dense(
                 self._data, max_bin=max_bin, weights=sketch_weights,
-                categorical=cat,
+                categorical=cat, cuts=cuts,
             )
             self._binned[max_bin] = bm
         return bm

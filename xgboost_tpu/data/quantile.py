@@ -31,7 +31,24 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["HistogramCuts", "compute_cuts", "bin_matrix", "BinnedMatrix"]
+__all__ = [
+    "HistogramCuts", "compute_cuts", "bin_matrix", "BinnedMatrix",
+    "apply_categorical_identity",
+]
+
+
+def apply_categorical_identity(values: np.ndarray, min_vals: np.ndarray,
+                               categorical: Sequence[int]) -> None:
+    """Overwrite categorical features' cuts with identity thresholds
+    ``[1..max_bin]`` so category code ``c`` lands in bin ``c`` — the
+    one-bin-per-category layout the reference builds for categorical data
+    (``hist_util.cc`` AddCutPoint categorical path). Shared by the local
+    and distributed sketches so the layouts cannot drift."""
+    max_bin = values.shape[1]
+    ident = np.arange(1, max_bin + 1, dtype=np.float32)
+    for f in categorical:
+        values[f] = ident
+        min_vals[f] = 0.0
 
 
 @dataclasses.dataclass
@@ -119,10 +136,7 @@ def compute_cuts(
     values = np.array(values)
     min_vals = np.array(min_vals)
     if categorical:
-        ident = np.arange(1, max_bin + 1, dtype=np.float32)
-        for f in categorical:
-            values[f] = ident
-            min_vals[f] = 0.0
+        apply_categorical_identity(values, min_vals, categorical)
     return HistogramCuts(values=values, min_vals=min_vals)
 
 
@@ -181,6 +195,30 @@ class BinnedMatrix:
     # max_cat_to_onehot one-hot/partition decision (evaluate_splits.h
     # UseOneHot gate).
     cat_counts: Tuple[int, ...] = ()
+    # cached row-sharded copy (rows padded to the mesh size with the
+    # missing bin so padded rows are inert), keyed by the mesh object
+    _sharded: Optional[Tuple[int, jax.Array, int]] = None
+
+    def sharded(self, mesh) -> Tuple[jax.Array, int]:
+        """(padded row-sharded bins, n_padded). Padding rows are all-missing
+        (bin id == max_bin) and carry zero gradients at use sites — the
+        fixed-shape analog of the reference's empty-worker handling
+        (dask.py:914)."""
+        from ..parallel.mesh import pad_to_multiple, shard_rows
+
+        if self._sharded is not None and self._sharded[0] == id(mesh):
+            return self._sharded[1], self._sharded[2]
+        D = mesh.devices.size
+        n = self.n_rows
+        n_pad = pad_to_multiple(n, D)
+        bins = self.bins
+        if n_pad != n:
+            pad = jnp.full((n_pad - n, self.n_features), self.cuts.missing_bin,
+                           dtype=self.bins.dtype)
+            bins = jnp.concatenate([self.bins, pad], axis=0)
+        shards = shard_rows(bins, mesh)
+        self._sharded = (id(mesh), shards, n_pad)
+        return shards, n_pad
 
     @classmethod
     def from_dense(

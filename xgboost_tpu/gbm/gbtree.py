@@ -128,9 +128,18 @@ class GBTree:
         """One boosting round: K groups x num_parallel_tree new trees.
         Returns (new trees, updated margin cache). The cache update is the
         UpdatePredictionCache fast path — leaf values gathered at each row's
-        final grower position, no predictor pass (gbtree.cc:219)."""
+        final grower position, no predictor pass (gbtree.cc:219).
+
+        Under an active mesh (``mesh_context``), rows are sharded over the
+        mesh and trees grow via the shard_map'd growers with psum'd
+        histograms — the reference's inter-node data-parallel strategy
+        (dsplit=row, histogram.h:201) with zero changes above this layer."""
+        from ..parallel.mesh import current_mesh
+
         tp = self.train_param
         cfg = self._grow_params()
+        mesh = current_mesh()
+        use_mesh = mesh is not None and mesh.devices.size > 1
         cats = tuple(getattr(binned, "categorical", ()))
         if cats:
             # one-hot vs optimal-partition gate (reference UseOneHot,
@@ -155,9 +164,28 @@ class GBTree:
             else:
                 max_leaves = 255
         new_trees: List[RegTree] = []
+        if use_mesh:
+            from ..parallel.grow import (
+                distributed_grow_tree,
+                distributed_grow_tree_lossguide,
+            )
+            from ..parallel.mesh import shard_rows
+
+            bins_sh, n_pad = binned.sharded(mesh)
+            n_rows = binned.n_rows
+
+            def _shard_gh(v: jax.Array) -> jax.Array:
+                if n_pad != n_rows:
+                    v = jnp.concatenate(
+                        [v, jnp.zeros((n_pad - n_rows,), v.dtype)]
+                    )
+                return shard_rows(v, mesh)
+
         for k in range(self.n_groups):
             g = grad[:, k] if grad.ndim == 2 else grad
             h = hess[:, k] if hess.ndim == 2 else hess
+            if use_mesh:
+                g, h = _shard_gh(g), _shard_gh(h)
             for ptree in range(self.gbtree_param.num_parallel_tree):
                 key = jax.random.PRNGKey(
                     (tp.seed * 1000003 + iteration * 131 + k * 17 + ptree) & 0x7FFFFFFF
@@ -170,9 +198,14 @@ class GBTree:
                 if lossguide:
                     from ..tree.grow_lossguide import grow_tree_lossguide
 
-                    alloc = grow_tree_lossguide(
-                        binned.bins, g, h, cut_vals, key, cfg, max_leaves, fw
-                    )
+                    if use_mesh:
+                        alloc = distributed_grow_tree_lossguide(
+                            mesh, bins_sh, g, h, cut_vals, key, cfg, max_leaves, fw
+                        )
+                    else:
+                        alloc = grow_tree_lossguide(
+                            binned.bins, g, h, cut_vals, key, cfg, max_leaves, fw
+                        )
                     tree, lmap_np = RegTree.from_alloc(
                         np.asarray(alloc.left), np.asarray(alloc.right),
                         np.asarray(alloc.feature), np.asarray(alloc.split_cond),
@@ -186,7 +219,12 @@ class GBTree:
                     )
                     positions = alloc.positions
                 else:
-                    heap = grow_tree(binned.bins, g, h, cut_vals, key, cfg, fw)
+                    if use_mesh:
+                        heap = distributed_grow_tree(
+                            mesh, bins_sh, g, h, cut_vals, key, cfg, fw
+                        )
+                    else:
+                        heap = grow_tree(binned.bins, g, h, cut_vals, key, cfg, fw)
                     is_split = np.asarray(heap.is_split)
                     loss_chg = np.asarray(heap.loss_chg)
                     pruned = prune_heap(is_split, loss_chg, tp.gamma)
@@ -211,6 +249,8 @@ class GBTree:
                 new_trees.append(tree)
                 if margin_cache is not None:
                     delta = jnp.asarray(lmap_np)[positions]
+                    if use_mesh and delta.shape[0] != binned.n_rows:
+                        delta = delta[: binned.n_rows]  # drop inert padding
                     if margin_cache.ndim == 2:
                         margin_cache = margin_cache.at[:, k].add(delta)
                     else:
@@ -239,6 +279,9 @@ class GBTree:
             "model": {
                 "gbtree_model_param": {
                     "num_trees": str(self.model.num_trees),
+                    # persisted so round-slicing semantics survive a JSON
+                    # round trip (reference GBTreeModelParam)
+                    "num_parallel_tree": str(self.gbtree_param.num_parallel_tree),
                     "size_leaf_vector": "0",
                 },
                 "trees": [t.to_json(i) for i, t in enumerate(self.model.trees)],
@@ -248,7 +291,11 @@ class GBTree:
 
     def load_json(self, j: dict) -> None:
         m = j["model"]
-        self.model = GBTreeModel(self.n_groups, self.gbtree_param.num_parallel_tree)
+        npt = int(m.get("gbtree_model_param", {}).get("num_parallel_tree", 0)) or (
+            self.gbtree_param.num_parallel_tree
+        )
+        self.gbtree_param.num_parallel_tree = npt
+        self.model = GBTreeModel(self.n_groups, npt)
         for tj, info in zip(m["trees"], m["tree_info"]):
             self.model.add(RegTree.from_json(tj), int(info))
 

@@ -5,5 +5,5 @@ from .mesh import (  # noqa: F401
     pad_to_multiple,
     shard_rows,
 )
-from .grow import distributed_grow_tree  # noqa: F401
+from .grow import distributed_grow_tree, distributed_grow_tree_lossguide  # noqa: F401
 from .sketch import distributed_compute_cuts  # noqa: F401

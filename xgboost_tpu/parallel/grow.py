@@ -20,7 +20,26 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..tree.grow import GrowParams, HeapTree, grow_tree
+from ..tree.grow_lossguide import AllocTree, grow_tree_lossguide
 from .mesh import ROW_AXIS
+
+
+def _row_sharded_call(mesh, grower, out_specs, args, feature_weights):
+    """shard_map a grower: rows sharded, cuts/key/feature_weights
+    replicated. feature_weights joins the traced args only when present so
+    the None default stays bit-identical with the single-device path."""
+    in_specs = [P(ROW_AXIS, None), P(ROW_AXIS), P(ROW_AXIS), P(None, None), P()]
+    if feature_weights is not None:
+        in_specs.append(P())
+        args = args + (feature_weights,)
+    fn = jax.shard_map(
+        grower,
+        mesh=mesh,
+        in_specs=tuple(in_specs),
+        out_specs=out_specs,
+        check_vma=False,
+    )
+    return fn(*args)
 
 
 def distributed_grow_tree(
@@ -31,6 +50,7 @@ def distributed_grow_tree(
     cut_values: jax.Array,  # [F, B] replicated
     key: jax.Array,
     cfg: GrowParams,
+    feature_weights: Optional[jax.Array] = None,  # [F] replicated
 ) -> HeapTree:
     """Grow one tree over row shards. Tree tensors come back replicated
     (bitwise identical on every device — the property the reference asserts
@@ -46,11 +66,34 @@ def distributed_grow_tree(
     out_specs = HeapTree(
         **{f: (P(ROW_AXIS) if f == "positions" else P()) for f in HeapTree._fields}
     )
-    fn = jax.shard_map(
-        partial(grow_tree, cfg=cfg_dist),
-        mesh=mesh,
-        in_specs=(P(ROW_AXIS, None), P(ROW_AXIS), P(ROW_AXIS), P(None, None), P()),
-        out_specs=out_specs,
-        check_vma=False,
+    return _row_sharded_call(
+        mesh, partial(grow_tree, cfg=cfg_dist), out_specs,
+        (bins, grad, hess, cut_values, key), feature_weights,
     )
-    return fn(bins, grad, hess, cut_values, key)
+
+
+def distributed_grow_tree_lossguide(
+    mesh: Mesh,
+    bins: jax.Array,  # [n, F] row-sharded
+    grad: jax.Array,
+    hess: jax.Array,
+    cut_values: jax.Array,  # [F, B] replicated
+    key: jax.Array,
+    cfg: GrowParams,
+    max_leaves: int,
+    feature_weights: Optional[jax.Array] = None,  # [F] replicated
+) -> AllocTree:
+    """Lossguide growth over row shards: per-step child histograms are
+    psum'd, the priority queue runs identically on every device (the
+    single-best-candidate argmax is deterministic on the reduced
+    histograms), so tree tensors come back replicated."""
+    import dataclasses
+
+    cfg_dist = dataclasses.replace(cfg, axis_name=ROW_AXIS)
+    out_specs = AllocTree(
+        **{f: (P(ROW_AXIS) if f == "positions" else P()) for f in AllocTree._fields}
+    )
+    return _row_sharded_call(
+        mesh, partial(grow_tree_lossguide, cfg=cfg_dist, max_leaves=max_leaves),
+        out_specs, (bins, grad, hess, cut_values, key), feature_weights,
+    )

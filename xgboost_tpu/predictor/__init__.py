@@ -36,6 +36,9 @@ class StackedForest(NamedTuple):
     tree_group: jax.Array  # int32 [T]
     max_depth: int  # static walk bound
     n_groups: int
+    # static: any categorical node in the forest? gates the bitset gather
+    # out of the compiled walk for the (common) all-numerical case
+    has_cats: bool = False
 
 
 def stack_forest(trees, tree_info, n_groups: int) -> StackedForest:
@@ -67,6 +70,9 @@ def stack_forest(trees, tree_info, n_groups: int) -> StackedForest:
         return out
 
     # ---- category bitsets ----
+    has_cats = any(
+        t.split_type is not None and bool(t.split_type.any()) for t in trees
+    )
     max_cat = 0  # highest category id appearing in any node set
     for t in trees:
         if t.split_type is not None and t.categories is not None:
@@ -109,16 +115,18 @@ def stack_forest(trees, tree_info, n_groups: int) -> StackedForest:
         tree_group=jnp.asarray(np.asarray(tree_info, np.int32)),
         max_depth=md,
         n_groups=n_groups,
+        has_cats=has_cats,
     )
 
 
-@partial(jax.jit, static_argnames=("max_depth",))
+@partial(jax.jit, static_argnames=("max_depth", "has_cats"))
 def _walk_leaves(
     X: jax.Array,  # [n, F] f32 with NaN missing
     left: jax.Array, right: jax.Array, feature: jax.Array,
     cond: jax.Array, default_left: jax.Array, split_type: jax.Array,
     cat_bits: jax.Array,  # uint32 [T, N, W]
     max_depth: int,
+    has_cats: bool = False,
 ) -> jax.Array:
     """Leaf index of every (tree, row): returns int32 [T, n]. Numerical
     nodes: left iff v < cond; categorical nodes (one-hot or partition): the
@@ -135,12 +143,15 @@ def _walk_leaves(
             leaf = lc[pos] == -1
             f = fi[pos]
             v = jnp.take_along_axis(X, f[:, None], axis=1)[:, 0]
-            vi = v.astype(jnp.int32)
-            in_range = (vi >= 0) & (vi < W * 32)
-            word = cb[pos, jnp.clip(vi >> 5, 0, W - 1)]
-            bit = (word >> (vi & 31).astype(jnp.uint32)) & jnp.uint32(1)
-            in_set = in_range & (bit == 1)
-            present = jnp.where(st[pos], ~in_set, v < co[pos])
+            if has_cats:
+                vi = v.astype(jnp.int32)
+                in_range = (vi >= 0) & (vi < W * 32)
+                word = cb[pos, jnp.clip(vi >> 5, 0, W - 1)]
+                bit = (word >> (vi & 31).astype(jnp.uint32)) & jnp.uint32(1)
+                in_set = in_range & (bit == 1)
+                present = jnp.where(st[pos], ~in_set, v < co[pos])
+            else:
+                present = v < co[pos]
             goleft = jnp.where(jnp.isnan(v), dl[pos], present)
             nxt = jnp.where(goleft, lc[pos], rc[pos])
             return jnp.where(leaf, pos, nxt)
@@ -150,15 +161,16 @@ def _walk_leaves(
     return jax.vmap(one_tree)(left, right, feature, cond, default_left, split_type, cat_bits)
 
 
-@partial(jax.jit, static_argnames=("n_groups", "max_depth"))
+@partial(jax.jit, static_argnames=("n_groups", "max_depth", "has_cats"))
 def _predict_margin_kernel(
     X: jax.Array,
     left, right, feature, cond, default_left, split_type, cat_bits, tree_group,
     tree_weights: jax.Array,  # f32 [T] (DART scaling; ones otherwise)
     base_margin: jax.Array,  # [n, n_groups]
-    n_groups: int, max_depth: int,
+    n_groups: int, max_depth: int, has_cats: bool = False,
 ) -> jax.Array:
-    leaves = _walk_leaves(X, left, right, feature, cond, default_left, split_type, cat_bits, max_depth)  # [T, n]
+    leaves = _walk_leaves(X, left, right, feature, cond, default_left,
+                          split_type, cat_bits, max_depth, has_cats)  # [T, n]
     leaf_vals = jnp.take_along_axis(cond, leaves, axis=1) * tree_weights[:, None]  # [T, n]
     # sum per output group (multiclass: one tree per class per round,
     # reference gbtree.cc:219 gradient slicing)
@@ -185,7 +197,7 @@ def predict_margin(
         forest.left, forest.right, forest.feature, forest.cond,
         forest.default_left, forest.split_type, forest.cat_bits,
         forest.tree_group, tw,
-        base_margin, forest.n_groups, forest.max_depth,
+        base_margin, forest.n_groups, forest.max_depth, forest.has_cats,
     )
 
 
@@ -197,6 +209,6 @@ def predict_leaf(forest: StackedForest, X: jax.Array) -> jax.Array:
         jnp.asarray(X, jnp.float32),
         forest.left, forest.right, forest.feature, forest.cond,
         forest.default_left, forest.split_type, forest.cat_bits,
-        forest.max_depth,
+        forest.max_depth, forest.has_cats,
     )
     return leaves.T

@@ -363,6 +363,12 @@ def grow_tree(
     bins32 = bins.astype(jnp.int32)
 
     k_sub, k_ctree, k_level = jax.random.split(key, 3)
+    if cfg.axis_name is not None:
+        # distributed: decorrelate ROW sampling across shards (each shard
+        # holds different rows) while keeping FEATURE sampling identical on
+        # every shard — the invariant the reference maintains by
+        # broadcasting the column-sampler seed (src/common/random.h:146)
+        k_sub = jax.random.fold_in(k_sub, jax.lax.axis_index(cfg.axis_name))
 
     # ---- row subsampling: zero the gradients of dropped rows (reference
     # hist semantics: unsampled rows keep flowing through partitions but

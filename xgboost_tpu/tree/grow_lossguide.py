@@ -88,6 +88,10 @@ def grow_tree_lossguide(
     max_depth = cfg.max_depth  # 0 = unbounded (the lossguide default)
 
     k_sub, k_ctree, k_node = jax.random.split(key, 3)
+    if cfg.axis_name is not None:
+        # decorrelate row sampling across shards; feature sampling keys stay
+        # shared (see grow.py — reference random.h:146 invariant)
+        k_sub = jax.random.fold_in(k_sub, jax.lax.axis_index(cfg.axis_name))
     if cfg.subsample < 1.0:
         keep = jax.random.bernoulli(k_sub, cfg.subsample, (n,))
         grad = jnp.where(keep, grad, 0.0)
