@@ -182,6 +182,57 @@ def _sample_features_exact(
     return jnp.zeros((n_features,), bool).at[perm[:k]].set(True)
 
 
+_HIST_BUDGET = 8_000_000  # (row, feature) workspace entries per block
+
+
+def blocked_histogram(
+    bins32: jax.Array,  # [n, F] int32 (missing == MB-1)
+    gh: jax.Array,  # [n, 2]
+    seg: jax.Array,  # [n] int32 target slot per row; -1 = skip
+    K: int,  # number of slots
+    MB: int,  # bins incl. missing
+    axis_name=None,
+) -> jax.Array:
+    """[K, F, MB, 2] scatter-add histogram over all (row, feature) pairs —
+    the analog of the reference's histogram kernels (CPU GHistBuilder
+    hist_util.h:323, GPU gpu_hist/histogram.cu:127). Scanned over feature
+    blocks so peak workspace is O(n * fb) instead of O(n * F) — the
+    VMEM-tiling idea of the reference's shared-memory feature groups
+    (gpu_hist/feature_groups.cu). Each block is one deterministic
+    segment_sum; distributed shards psum the fixed-size result
+    (histogram.h:201 / updater_gpu_hist.cu:526)."""
+    n, F = bins32.shape
+    fb = min(F, max(1, _HIST_BUDGET // max(n, 1)))
+    nb = -(-F // fb)
+    Fp = nb * fb
+    if Fp != F:
+        # pad with all-missing feature columns; their counts land in the
+        # padded features' missing bins and are sliced away below
+        pad = jnp.full((n, Fp - F), MB - 1, dtype=bins32.dtype)
+        bins32 = jnp.concatenate([bins32, pad], axis=1)
+
+    def block(i):  # -> [K, fb, MB, 2] histogram of features [i*fb, (i+1)*fb)
+        blk = jax.lax.dynamic_slice_in_dim(bins32, i * fb, fb, axis=1)
+        sid = (
+            seg[:, None] * (fb * MB)
+            + jnp.arange(fb, dtype=jnp.int32)[None, :] * MB
+            + blk.astype(jnp.int32)
+        )
+        sid = jnp.where(seg[:, None] >= 0, sid, -1)
+        ghb = jnp.broadcast_to(gh[:, None, :], (n, fb, 2)).reshape(-1, 2)
+        h = jax.ops.segment_sum(ghb, sid.reshape(-1), num_segments=K * fb * MB)
+        return h.reshape(K, fb, MB, 2)
+
+    if nb == 1:
+        hist = block(0)
+    else:
+        _, hs = jax.lax.scan(lambda c, i: (c, block(i)), None, jnp.arange(nb))
+        hist = jnp.transpose(hs, (1, 0, 2, 3, 4)).reshape(K, Fp, MB, 2)[:, :F]
+    if axis_name is not None:
+        hist = jax.lax.psum(hist, axis_name=axis_name)
+    return hist
+
+
 class SplitDecision(NamedTuple):
     """Best split per node row (all [K])."""
 
@@ -413,16 +464,11 @@ def grow_tree(
         local = pos - offset
         level_active = (local >= 0) & (local < width)
 
-        # ---- histogram: one scatter-add over all (row, feature) pairs ----
-        sid = local[:, None] * (F * MB) + jnp.arange(F, dtype=jnp.int32)[None, :] * MB + bins32
-        sid = jnp.where(level_active[:, None], sid, -1)
-        gh_full = jnp.broadcast_to(gh[:, None, :], (n, F, 2)).reshape(-1, 2)
-        hist = jax.ops.segment_sum(gh_full, sid.reshape(-1), num_segments=Nmax * F * MB)
-        hist = hist.reshape(Nmax, F, MB, 2)
-        if cfg.axis_name is not None:
-            # distributed row-sharded training: the one collective of the
-            # hot loop (cost independent of row count)
-            hist = jax.lax.psum(hist, axis_name=cfg.axis_name)
+        # ---- histogram: scatter-add over all (row, feature) pairs, scanned
+        # over feature blocks; under a mesh the fixed-size result is psum'd
+        # (the one collective of the hot loop, cost independent of rows) ----
+        seg = jnp.where(level_active, local, -1)
+        hist = blocked_histogram(bins32, gh, seg, Nmax, MB, cfg.axis_name)
 
         # node totals: every row hits exactly one bin of feature 0
         Gtot = hist[:, 0, :, 0].sum(-1)  # [Nmax]

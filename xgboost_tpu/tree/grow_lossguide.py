@@ -37,6 +37,7 @@ import numpy as np
 from .grow import (
     GrowParams,
     _sample_features_exact,
+    blocked_histogram,
     child_bounds_and_weights,
     eval_splits,
     interaction_allowed,
@@ -121,18 +122,11 @@ def grow_tree_lossguide(
         cat_any_j, cat_oh_j, catp_j = cfg.cat_masks_jnp(F)
 
     gh = jnp.stack([grad, hess], axis=-1)
-    gh_full = jnp.broadcast_to(gh[:, None, :], (n, F, 2)).reshape(-1, 2)
-    feat_off = jnp.arange(F, dtype=jnp.int32)[None, :] * MB + bins32  # [n, F]
 
     def pair_hist(side):
-        """ONE segment_sum over all rows for a +0/+1 side selector ->
+        """Feature-block-scanned scatter-add for a +0/+1 side selector ->
         [2, F, MB, 2]. side[i] in {-1 (skip), 0 (left child), 1 (right)}."""
-        sid = jnp.where(side[:, None] >= 0, side[:, None] * (F * MB) + feat_off, -1)
-        h = jax.ops.segment_sum(gh_full, sid.reshape(-1), num_segments=2 * F * MB)
-        h = h.reshape(2, F, MB, 2)
-        if cfg.axis_name is not None:
-            h = jax.lax.psum(h, axis_name=cfg.axis_name)
-        return h
+        return blocked_histogram(bins32, gh, side, 2, MB, cfg.axis_name)
 
     def node_masks(node_ids, depths, used_rows):
         """[K, F] feature mask for a batch of nodes (colsample bylevel via
