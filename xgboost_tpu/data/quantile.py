@@ -198,6 +198,45 @@ class BinnedMatrix:
     # cached row-sharded copy (rows padded to the mesh size with the
     # missing bin so padded rows are inert), keyed by the mesh object
     _sharded: Optional[Tuple[int, jax.Array, int]] = None
+    # cached int32 copy padded to the fused kernel's row tile (pad rows
+    # all-missing + zero gradients => inert, same trick as ``sharded``)
+    _fused: Optional[Tuple[jax.Array, int]] = None
+    _fused_mesh: Optional[Tuple[int, jax.Array, int]] = None
+
+    def fused_bins(self) -> Tuple[jax.Array, int]:
+        """(bins padded to the kernel row tile, padded row count) for the
+        fused grower. Kept in the narrow storage dtype — the int32 widening
+        the kernels want happens transiently inside the jit program, so no
+        persistent 2-4x copy of the bin matrix is held in HBM."""
+        if self._fused is None:
+            from ..tree.grow_fused import pad_rows
+
+            n_pad = pad_rows(self.n_rows)
+            self._fused = (self._pad_narrow(n_pad), n_pad)
+        return self._fused
+
+    def _pad_narrow(self, n_pad: int) -> jax.Array:
+        b = self.bins
+        if n_pad != self.n_rows:
+            pad = jnp.full((n_pad - self.n_rows, self.n_features),
+                           self.cuts.missing_bin, self.bins.dtype)
+            b = jnp.concatenate([b, pad])
+        return b
+
+    def fused_bins_mesh(self, mesh) -> Tuple[jax.Array, int]:
+        """Row-sharded bins for the fused grower under a mesh: rows padded
+        (all-missing, inert) to a multiple of tile x devices."""
+        if self._fused_mesh is not None and self._fused_mesh[0] == id(mesh):
+            return self._fused_mesh[1], self._fused_mesh[2]
+        from ..parallel.mesh import shard_rows
+        from ..tree.grow_fused import TR
+
+        D = mesh.devices.size
+        unit = TR * D
+        n_pad = -(-self.n_rows // unit) * unit
+        shards = shard_rows(self._pad_narrow(n_pad), mesh)
+        self._fused_mesh = (id(mesh), shards, n_pad)
+        return shards, n_pad
 
     def sharded(self, mesh) -> Tuple[jax.Array, int]:
         """(padded row-sharded bins, n_padded). Padding rows are all-missing

@@ -20,33 +20,174 @@ from ..params import GBTreeParam, TrainParam
 from ..predictor import StackedForest, predict_leaf, predict_margin, stack_forest
 from ..registry import BOOSTERS
 from ..tree.grow import GrowParams, grow_tree, leaf_value_map, prune_heap
+from ..tree.grow_fused import GrownTree, grow_tree_fused
 from ..tree.model import RegTree
 from ..tree.param import SplitParams
 from ..utils import console_logger
 
 
+class _PendingTree:
+    """A tree still living on device as heap-layout arrays (GrownTree minus
+    the per-row delta). RegTree materialization is deferred until model IO
+    or host introspection needs it — each device->host sync costs more than
+    an entire tree build, so the training loop never pays it."""
+
+    __slots__ = ("keep", "feature", "split_bin", "split_cond", "default_left",
+                 "node_weight", "loss_chg", "node_h", "leaf_value", "eta",
+                 "max_depth")
+
+    def __init__(self, g: GrownTree, eta: float, max_depth: int):
+        self.keep = g.keep
+        self.feature = g.feature
+        self.split_bin = g.split_bin
+        self.split_cond = g.split_cond
+        self.default_left = g.default_left
+        self.node_weight = g.node_weight
+        self.loss_chg = g.loss_chg
+        self.node_h = g.node_h
+        self.leaf_value = g.leaf_value
+        self.eta = eta
+        self.max_depth = max_depth
+
+
+def _materialize_pending(pending: List[_PendingTree]) -> List[RegTree]:
+    """Convert device trees to host RegTrees in a handful of bulk transfers
+    (one stacked array per field) instead of per-tree round trips."""
+    if not pending:
+        return []
+    fields = ("keep", "feature", "split_cond", "default_left", "node_weight",
+              "loss_chg", "node_h", "split_bin")
+    sizes = [t.keep.shape[0] for t in pending]
+    Nmax = max(sizes)
+
+    def stack(f):
+        # trees can differ in max_nodes if max_depth changed between rounds;
+        # pad (zeros => leaves) to the common width before stacking
+        arrs = [getattr(t, f) for t in pending]
+        arrs = [a if a.shape[0] == Nmax else jnp.pad(a, (0, Nmax - a.shape[0]))
+                for a in arrs]
+        return np.asarray(jnp.stack(arrs))
+
+    stacked = {f: stack(f) for f in fields}
+    out = []
+    for i, t in enumerate(pending):
+        m = sizes[i]
+        out.append(RegTree.from_heap(
+            stacked["keep"][i][:m], stacked["feature"][i][:m],
+            stacked["split_cond"][i][:m], stacked["default_left"][i][:m],
+            stacked["node_weight"][i][:m], stacked["loss_chg"][i][:m],
+            stacked["node_h"][i][:m], eta=t.eta,
+            split_bin=stacked["split_bin"][i][:m],
+        ))
+    return out
+
+
+def _stack_device(pending: List[_PendingTree], tree_info: List[int],
+                  n_groups: int) -> StackedForest:
+    """Stacked forest directly from device heap trees — no host transfer.
+    Heap layout is itself a valid node indexing (children of i at 2i+1/2i+2);
+    leaves carry their governing (pruned) leaf value. The tree list is padded
+    to a power of two with zero-leaf dummies so the predictor recompiles only
+    log2(T) times over a whole training run."""
+    T = len(pending)
+    Tp = 1 << (T - 1).bit_length() if T > 1 else 1
+    N = max(t.keep.shape[0] for t in pending)
+    Np = max(1, 1 << (N - 1).bit_length())
+    md = max(t.max_depth for t in pending)
+
+    def stack(get, fill, dtype):
+        arrs = [get(t) for t in pending]
+        arrs = [a if a.shape[0] == N
+                else jnp.pad(a, (0, N - a.shape[0]), constant_values=fill)
+                for a in arrs]
+        s = jnp.stack(arrs)
+        if N != Np:
+            s = jnp.pad(s, ((0, 0), (0, Np - N)), constant_values=fill)
+        if Tp != T:
+            s = jnp.pad(s, ((0, Tp - T), (0, 0)), constant_values=fill)
+        return s.astype(dtype)
+
+    keep = stack(lambda t: t.keep, False, bool)
+    iota = jnp.arange(Np, dtype=jnp.int32)[None, :]
+    left = jnp.where(keep, 2 * iota + 1, -1)
+    right = jnp.where(keep, 2 * iota + 2, -1)
+    cond = jnp.where(keep,
+                     stack(lambda t: t.split_cond, 0.0, jnp.float32),
+                     stack(lambda t: t.leaf_value, 0.0, jnp.float32))
+    group = np.zeros(Tp, np.int32)
+    group[:T] = np.asarray(tree_info, np.int32)
+    return StackedForest(
+        left=left, right=right,
+        feature=stack(lambda t: t.feature, 0, jnp.int32),
+        cond=cond,
+        default_left=stack(lambda t: t.default_left, False, bool),
+        split_type=jnp.zeros((Tp, Np), bool),
+        cat_bits=jnp.zeros((Tp, Np, 1), jnp.uint32),
+        tree_group=jnp.asarray(group),
+        max_depth=max(md, 1),
+        n_groups=n_groups,
+        has_cats=False,
+    )
+
+
 class GBTreeModel:
-    """Tree collection + group ids (reference: ``src/gbm/gbtree_model.h``)."""
+    """Tree collection + group ids (reference: ``src/gbm/gbtree_model.h``).
+
+    Trees grown by the fused TPU path are kept on device (``_PendingTree``)
+    and materialized to host ``RegTree`` lazily; host-origin trees (JSON
+    load, lossguide path) are stored directly."""
 
     def __init__(self, n_groups: int = 1, num_parallel_tree: int = 1):
         self.n_groups = n_groups
         self.num_parallel_tree = max(1, num_parallel_tree)
-        self.trees: List[RegTree] = []
+        self._entries: List[Any] = []  # RegTree | _PendingTree
         self.tree_info: List[int] = []
         self._stacked: Optional[StackedForest] = None
+        self._stacked_count: int = -1
 
     def add(self, tree: RegTree, group: int) -> None:
-        self.trees.append(tree)
+        self._entries.append(tree)
+        self.tree_info.append(group)
+        self._stacked = None
+
+    def add_device(self, grown: GrownTree, eta: float, group: int,
+                   max_depth: int) -> None:
+        self._entries.append(_PendingTree(grown, eta, max_depth))
         self.tree_info.append(group)
         self._stacked = None
 
     @property
+    def trees(self) -> List[RegTree]:
+        pending_ix = [i for i, e in enumerate(self._entries)
+                      if isinstance(e, _PendingTree)]
+        if pending_ix:
+            converted = _materialize_pending(
+                [self._entries[i] for i in pending_ix]
+            )
+            for i, t in zip(pending_ix, converted):
+                self._entries[i] = t
+            # a device-stacked forest uses raw heap node ids; after
+            # materialization node ids are BFS-compacted — rebuild so
+            # pred_leaf etc. are consistent with the saved model
+            self._stacked = None
+        return self._entries
+
+    @property
     def num_trees(self) -> int:
-        return len(self.trees)
+        return len(self._entries)
 
     def stacked(self) -> StackedForest:
-        if self._stacked is None:
-            self._stacked = stack_forest(self.trees, self.tree_info, self.n_groups)
+        if self._stacked is not None and self._stacked_count == len(self._entries):
+            return self._stacked
+        if self._entries and all(
+            isinstance(e, _PendingTree) for e in self._entries
+        ):
+            self._stacked = _stack_device(self._entries, self.tree_info,
+                                          self.n_groups)
+        else:
+            self._stacked = stack_forest(self.trees, self.tree_info,
+                                         self.n_groups)
+        self._stacked_count = len(self._entries)
         return self._stacked
 
     def slice(self, begin: int, end: int, step: int = 1) -> "GBTreeModel":
@@ -54,10 +195,11 @@ class GBTreeModel:
         # layered slicing: rounds -> trees_per_round trees (gbtree slicing
         # semantics operate on boosting rounds; one round appends
         # n_groups * num_parallel_tree trees — gbtree.cc:326)
+        trees = self.trees
         per_round = max(1, self.n_groups) * self.num_parallel_tree
         for r in range(begin, end, step):
-            for t in range(r * per_round, min((r + 1) * per_round, len(self.trees))):
-                out.add(self.trees[t], self.tree_info[t])
+            for t in range(r * per_round, min((r + 1) * per_round, len(trees))):
+                out.add(trees[t], self.tree_info[t])
         return out
 
 
@@ -141,6 +283,12 @@ class GBTree:
         mesh = current_mesh()
         use_mesh = mesh is not None and mesh.devices.size > 1
         cats = tuple(getattr(binned, "categorical", ()))
+        lossguide_pol = tp.grow_policy == "lossguide"
+        # fast path: fused per-level kernels, device-resident trees, zero
+        # host syncs per round (depthwise, numerical; mesh-aware)
+        if not lossguide_pol and not cats:
+            return self._boost_fused(binned, grad, hess, iteration,
+                                     margin_cache, feature_weights)
         if cats:
             # one-hot vs optimal-partition gate (reference UseOneHot,
             # evaluate_splits.h: one-hot when n_cats < max_cat_to_onehot)
@@ -258,6 +406,65 @@ class GBTree:
         return new_trees, margin_cache
 
     # ------------------------------------------------------------------
+    def _boost_fused(
+        self, binned, grad, hess, iteration,
+        margin_cache, feature_weights=None,
+    ):
+        """Fast-path round: ``grow_tree_fused`` builds each tree, its gamma
+        pruning / leaf values / prediction-cache delta all on device; the
+        tree is stored as device arrays and materialized lazily."""
+        from ..parallel.mesh import current_mesh, shard_rows
+
+        tp = self.train_param
+        cfg = self._grow_params()
+        mesh = current_mesh()
+        use_mesh = mesh is not None and mesh.devices.size > 1
+        n = binned.n_rows
+        if use_mesh:
+            from ..parallel.grow import distributed_grow_tree_fused
+
+            binsf, n_pad = binned.fused_bins_mesh(mesh)
+        else:
+            binsf, n_pad = binned.fused_bins()
+        cut_vals = jnp.asarray(binned.cuts.values)
+        fw = (jnp.asarray(feature_weights)
+              if feature_weights is not None else None)
+        new_trees = []
+        for k in range(self.n_groups):
+            g = grad[:, k] if grad.ndim == 2 else grad
+            h = hess[:, k] if hess.ndim == 2 else hess
+            if n_pad != n:
+                pad = jnp.zeros((n_pad - n,), jnp.float32)
+                g = jnp.concatenate([g, pad])
+                h = jnp.concatenate([h, pad])
+            if use_mesh:
+                g, h = shard_rows(g, mesh), shard_rows(h, mesh)
+            for ptree in range(self.gbtree_param.num_parallel_tree):
+                key = jax.random.PRNGKey(
+                    (tp.seed * 1000003 + iteration * 131 + k * 17 + ptree)
+                    & 0x7FFFFFFF
+                )
+                if use_mesh:
+                    grown = distributed_grow_tree_fused(
+                        mesh, binsf, g, h, cut_vals, key,
+                        jnp.float32(tp.eta), jnp.float32(tp.gamma), cfg, fw,
+                    )
+                else:
+                    grown = grow_tree_fused(
+                        binsf, g, h, cut_vals, key,
+                        float(tp.eta), float(tp.gamma), cfg, fw,
+                    )
+                self.model.add_device(grown, tp.eta, k, tp.max_depth)
+                new_trees.append(grown)
+                if margin_cache is not None:
+                    delta = grown.delta[:n]
+                    if margin_cache.ndim == 2:
+                        margin_cache = margin_cache.at[:, k].add(delta)
+                    else:
+                        margin_cache = margin_cache + delta
+        return new_trees, margin_cache
+
+    # ------------------------------------------------------------------
     def training_margin(self, X, base_margin: jax.Array) -> jax.Array:
         """Margin used to compute this round's gradients (DART overrides to
         apply dropout)."""
@@ -270,6 +477,9 @@ class GBTree:
         return predict_margin(self.model.stacked(), X, base_margin, self.tree_weights())
 
     def predict_leaf(self, X) -> jax.Array:
+        # leaf ids must match the (BFS-compacted) saved model, not the
+        # device heap layout: force materialization before stacking
+        _ = self.model.trees
         return predict_leaf(self.model.stacked(), X)
 
     # ------------------------------------------------------------------

@@ -20,6 +20,7 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..tree.grow import GrowParams, HeapTree, grow_tree
+from ..tree.grow_fused import GrownTree, grow_tree_fused
 from ..tree.grow_lossguide import AllocTree, grow_tree_lossguide
 from .mesh import ROW_AXIS
 
@@ -70,6 +71,43 @@ def distributed_grow_tree(
         mesh, partial(grow_tree, cfg=cfg_dist), out_specs,
         (bins, grad, hess, cut_values, key), feature_weights,
     )
+
+
+def distributed_grow_tree_fused(
+    mesh: Mesh,
+    bins: jax.Array,  # [n_pad, F] int32 row-sharded (missing == B padding)
+    grad: jax.Array,  # [n_pad] row-sharded (pad rows zero)
+    hess: jax.Array,
+    cut_values: jax.Array,  # [F, B] replicated
+    key: jax.Array,
+    eta: float,
+    gamma: float,
+    cfg: GrowParams,
+    feature_weights: Optional[jax.Array] = None,
+) -> GrownTree:
+    """The fused fast-path grower over row shards: per-level histograms and
+    root totals are psum'd inside ``grow_tree_fused`` (the reference's two
+    collective sites, hist/histogram.h:201 + InitRoot); tree tensors come
+    back replicated, the per-row cache delta stays sharded."""
+    import dataclasses
+
+    cfg_dist = dataclasses.replace(cfg, axis_name=ROW_AXIS)
+    out_specs = GrownTree(
+        **{f: (P(ROW_AXIS) if f == "delta" else P()) for f in GrownTree._fields}
+    )
+    grower = partial(grow_tree_fused, cfg=cfg_dist)
+
+    in_specs = [P(ROW_AXIS, None), P(ROW_AXIS), P(ROW_AXIS), P(None, None),
+                P(), P(), P()]
+    args = (bins, grad, hess, cut_values, key, eta, gamma)
+    if feature_weights is not None:
+        in_specs.append(P())
+        args = args + (feature_weights,)
+    fn = jax.shard_map(
+        grower, mesh=mesh, in_specs=tuple(in_specs), out_specs=out_specs,
+        check_vma=False,
+    )
+    return fn(*args)
 
 
 def distributed_grow_tree_lossguide(

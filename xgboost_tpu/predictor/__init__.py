@@ -187,11 +187,13 @@ def predict_margin(
     """[n, n_groups] raw margins (base + forest sums)."""
     if forest.left.shape[0] == 0:
         return base_margin
-    tw = (
-        tree_weights
-        if tree_weights is not None
-        else jnp.ones((forest.left.shape[0],), jnp.float32)
-    )
+    T = forest.left.shape[0]
+    if tree_weights is not None:
+        tw = tree_weights
+        if tw.shape[0] < T:  # forest tree-dim is pow2-padded with zero-leaf
+            tw = jnp.concatenate([tw, jnp.zeros((T - tw.shape[0],), jnp.float32)])
+    else:
+        tw = jnp.ones((T,), jnp.float32)
     return _predict_margin_kernel(
         jnp.asarray(X, jnp.float32),
         forest.left, forest.right, forest.feature, forest.cond,

@@ -1,0 +1,246 @@
+"""Fused partition + level-histogram kernels for tpu_hist.
+
+Reference equivalents: the histogram kernel ``gpu_hist/histogram.cu:127-177``
+(shared-memory atomic scatter-add per feature group) and the row partitioner
+``gpu_hist/row_partitioner.cu``. TPUs have no fast scatter, so the TPU-native
+formulation turns the histogram into MXU work: for every feature a one-hot
+``[rows, n_bins]`` matrix is generated **in VMEM** (never touching HBM) and
+contracted against per-node gradient columns on the systolic array. Gradient
+precision comes from a hi/lo bfloat16 split (bitcast-masked so the compiler
+cannot simplify it away): two bf16 terms carry ~16 significand bits, so
+histogram sums land within ~2^-16 relative of exact f32 — the same error
+class as the reference's single-precision accumulation, but deterministic
+(its GPU kernel needs fixed-point atomics for that,
+``gpu_hist/histogram.cu:81-120``). Near-tie splits may therefore resolve
+differently than the f32 segment_sum fallback used on non-TPU backends.
+
+The partition step (route every row through its node's split decision) is
+fused into the same kernel: node decision tables are tiny, so the lookup is
+a one-hot matmul against a ``[nodes, 4]`` table, and the per-row feature
+value is selected with a one-hot dot over the feature axis — no gathers
+anywhere (XLA/Mosaic gathers serialize on TPU).
+
+Missing values: the quantized matrix encodes missing as bin id ``B``; the
+one-hot over ``[0, B)`` is then all-zero, so missing rows simply drop out of
+the histogram. Their per-feature sums are recovered as
+``node_total - sum(bins)`` (the ELLPACK null-symbol trick inverted), keeping
+the matmul lane count at exactly ``B`` — no padding waste.
+
+A pure-XLA fallback (`fused_level_xla`) with identical semantics serves
+non-TPU backends (CPU tests, virtual-device dryruns) via segment_sum.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "fused_level", "fused_level_xla", "partition_apply_xla", "leaf_delta",
+    "TR", "use_pallas",
+]
+
+TR = 1024  # rows per kernel grid step
+
+# 0xFFFF0000 as int32: masks an f32 down to its bf16-representable prefix
+_MASK_HI = np.int32(np.uint32(0xFFFF0000).view(np.int32))
+
+# kernels unroll the feature loop; very wide matrices would explode compile
+# time, so the dispatcher falls back to XLA beyond this width
+_MAX_KERNEL_FEATURES = 512
+
+
+def use_pallas() -> bool:
+    """Whether the fused TPU kernel path is usable on the default backend."""
+    return jax.default_backend() == "tpu"
+
+
+def _split_hilo(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Exact f32 = hi + lo with both parts bf16-representable. Done with a
+    bitcast mask (not a dtype round-trip) so XLA/Mosaic cannot fold
+    ``convert(convert(x))`` back into ``x`` and silently drop the lo term."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    hi = pltpu.bitcast(pltpu.bitcast(x, jnp.int32) & _MASK_HI, jnp.float32)
+    return hi, x - hi
+
+
+def _level_kernel(bins_ref, pos_ref, gh_ref, ptab_ref, pos_out, hist_ref,
+                  *, K: int, Kp: int, F: int, B: int,
+                  prev_offset: int, offset: int):
+    """One grid step: partition `Tr` rows through the previous level's
+    decisions, then accumulate their (g, h) into this level's histogram."""
+    from jax.experimental import pallas as pl
+
+    c = pl.program_id(0)
+    Tr = bins_ref.shape[0]
+
+    @pl.when(c == 0)
+    def _():
+        hist_ref[...] = jnp.zeros_like(hist_ref)
+
+    pos = pos_ref[:, :]  # [Tr, 1] i32 heap positions
+    binsb = bins_ref[:, :]  # [Tr, F] i32
+
+    if Kp > 0:
+        lp = pos - prev_offset
+        iota_kp = jax.lax.broadcasted_iota(jnp.int32, (Tr, Kp), 1)
+        ohp = (lp == iota_kp).astype(jnp.float32)
+        # f32 table matmul: exact for feature ids / bin ids up to 2^24
+        dec = jax.lax.dot_general(
+            ohp, ptab_ref[:, :], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )  # [Tr, 4] = (is_split, feature, bin, default_left)
+        isp_of = dec[:, 0:1]
+        f_of = dec[:, 1:2].astype(jnp.int32)
+        b_of = dec[:, 2:3]
+        dl_of = dec[:, 3:4]
+        iota_f = jax.lax.broadcasted_iota(jnp.int32, (Tr, F), 1)
+        ohf = (f_of == iota_f).astype(jnp.float32)
+        bv = jnp.sum(ohf * binsb.astype(jnp.float32), axis=1, keepdims=True)
+        # arithmetic (not boolean) masks: Mosaic rejects i1 vectors at lane 1
+        missing = (bv >= B).astype(jnp.float32)
+        leq = (bv <= b_of).astype(jnp.float32)
+        goleft = missing * dl_of + (1.0 - missing) * leq
+        inb = (lp >= 0).astype(jnp.float32) * (lp < Kp).astype(jnp.float32)
+        goes = inb * isp_of
+        child = 2 * pos + 1 + (goleft < 0.5).astype(jnp.int32)
+        pos = pos + (goes > 0.5).astype(jnp.int32) * (child - pos)
+    pos_out[:, :] = pos
+
+    local = pos - offset
+    iota_k = jax.lax.broadcasted_iota(jnp.int32, (Tr, K), 1)
+    ohseg = (local == iota_k).astype(jnp.float32)  # [Tr, K]
+    g = gh_ref[:, 0:1]
+    h = gh_ref[:, 1:2]
+    g_hi, g_lo = _split_hilo(g)
+    h_hi, h_lo = _split_hilo(h)
+    # column order [g_hi | h_hi | g_lo | h_lo]: out[:2K] + out[2K:] = [g, h]
+    ghs4 = jnp.concatenate(
+        [ohseg * g_hi, ohseg * h_hi, ohseg * g_lo, ohseg * h_lo], axis=1
+    ).astype(jnp.bfloat16)  # [Tr, 4K]
+
+    for f in range(F):
+        col = binsb[:, f:f + 1]
+        iota_b = jax.lax.broadcasted_iota(jnp.int32, (Tr, B), 1)
+        oh = (col == iota_b).astype(jnp.bfloat16)  # missing (==B) -> zero row
+        out = jax.lax.dot_general(
+            ghs4, oh, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [4K, B]
+        hist_ref[f, :, :] += out[:2 * K] + out[2 * K:]
+
+
+@functools.partial(jax.jit, static_argnames=("K", "Kp", "B", "d", "tr"))
+def _fused_level_pallas(bins, pos, gh, ptab, *, K, Kp, B, d, tr=TR):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n, F = bins.shape
+    assert n % tr == 0, f"rows {n} not padded to {tr}"
+    prev_offset = (1 << (d - 1)) - 1 if d > 0 else 0
+    offset = (1 << d) - 1
+    kern = functools.partial(
+        _level_kernel, K=K, Kp=Kp, F=F, B=B,
+        prev_offset=prev_offset, offset=offset,
+    )
+    return pl.pallas_call(
+        kern,
+        grid=(n // tr,),
+        in_specs=[
+            pl.BlockSpec((tr, F), lambda c: (c, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((tr, 1), lambda c: (c, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((tr, 2), lambda c: (c, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((max(Kp, 1), 4), lambda c: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((tr, 1), lambda c: (c, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((F, 2 * K, B), lambda c: (0, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, 1), jnp.int32),
+            jax.ShapeDtypeStruct((F, 2 * K, B), jnp.float32),
+        ],
+    )(bins, pos, gh, ptab)
+
+
+def partition_apply_xla(bins, pos, ptab, *, Kp: int, B: int, d: int):
+    """Route rows through level ``d-1``'s decisions (XLA, gather-free where
+    it matters: the per-node table lookup is a one-hot matmul)."""
+    prev_offset = (1 << (d - 1)) - 1 if d > 0 else 0
+    lp = pos[:, 0] - prev_offset  # [n]
+    ohp = jax.nn.one_hot(jnp.where((lp >= 0) & (lp < Kp), lp, Kp),
+                         Kp + 1, dtype=jnp.float32)[:, :Kp]  # [n, Kp]
+    dec = jax.lax.dot_general(ohp, ptab, (((1,), (0,)), ((), ())),
+                              preferred_element_type=jnp.float32,
+                              precision=jax.lax.Precision.HIGHEST)  # [n, 4]
+    isp_of = dec[:, 0]
+    f_of = dec[:, 1].astype(jnp.int32)
+    b_of = dec[:, 2]
+    dl_of = dec[:, 3]
+    bv = jnp.take_along_axis(bins, f_of[:, None], axis=1)[:, 0].astype(jnp.float32)
+    missing = bv >= B
+    goleft = jnp.where(missing, dl_of > 0.5, bv <= b_of)
+    inb = (lp >= 0) & (lp < Kp)
+    goes = inb & (isp_of > 0.5)
+    p = pos[:, 0]
+    p = jnp.where(goes, jnp.where(goleft, 2 * p + 1, 2 * p + 2), p)
+    return p[:, None]
+
+
+@functools.partial(jax.jit, static_argnames=("K", "Kp", "B", "d"))
+def fused_level_xla(bins, pos, gh, ptab, *, K, Kp, B, d):
+    """Same contract as the pallas kernel, for non-TPU backends: partition
+    via (cheap on CPU) gathers, histogram via segment_sum scatter-add."""
+    if Kp > 0:
+        pos = partition_apply_xla(bins, pos, ptab, Kp=Kp, B=B, d=d)
+    offset = (1 << d) - 1
+    local = pos[:, 0] - offset
+    n, F = bins.shape
+    seg = jnp.where((local >= 0) & (local < K), local, -1)
+    MB = B + 1
+    from .grow import blocked_histogram
+
+    hist = blocked_histogram(bins, gh, seg, K, MB)  # [K, F, MB, 2]
+    # -> kernel layout [F, 2K, B] (drop the missing bin: recovered by caller)
+    hg = jnp.transpose(hist[:, :, :B, 0], (1, 0, 2))  # [F, K, B]
+    hh = jnp.transpose(hist[:, :, :B, 1], (1, 0, 2))
+    return pos, jnp.concatenate([hg, hh], axis=1)  # [F, 2K, B]
+
+
+_VMEM_ACC_BUDGET = 6 * 1024 * 1024  # bytes for the [F, 2K, B] accumulator
+
+
+def fused_level(bins, pos, gh, ptab, *, K, Kp, B, d, pallas: bool):
+    """Dispatch: (new pos [n,1] i32, hist [F, 2K, B] f32). ``hist`` excludes
+    the missing bin (derive per-feature missing sums as total - sum)."""
+    F = bins.shape[1]
+    acc_bytes = F * 2 * K * B * 4
+    if pallas and F <= _MAX_KERNEL_FEATURES and acc_bytes <= _VMEM_ACC_BUDGET:
+        return _fused_level_pallas(bins, pos, gh, ptab, K=K, Kp=Kp, B=B, d=d)
+    return fused_level_xla(bins, pos, gh, ptab, K=K, Kp=Kp, B=B, d=d)
+
+
+def leaf_delta(pos, leaf_values, max_nodes_pad: int, pallas: bool):
+    """Prediction-cache delta: ``leaf_values[pos]`` for every row, as an
+    exact hi/lo one-hot matmul (TPU) or a plain gather (CPU). This is the
+    UpdatePredictionCache fast path (reference ``gbtree.cc:219``)."""
+    p = pos[:, 0]
+    if not pallas:
+        return leaf_values[jnp.clip(p, 0, leaf_values.shape[0] - 1)]
+    lv = jnp.zeros((max_nodes_pad,), jnp.float32).at[:leaf_values.shape[0]].set(leaf_values)
+    hi = jax.lax.bitcast_convert_type(
+        jax.lax.bitcast_convert_type(lv, jnp.int32) & _MASK_HI, jnp.float32)
+    lo = lv - hi
+    tab = jnp.stack([hi, lo], axis=1).astype(jnp.bfloat16)  # [P, 2]
+    oh = jax.nn.one_hot(p, max_nodes_pad, dtype=jnp.bfloat16)
+    out = jax.lax.dot_general(oh, tab, (((1,), (0,)), ((), ())),
+                              preferred_element_type=jnp.float32)  # [n, 2]
+    return out[:, 0] + out[:, 1]
