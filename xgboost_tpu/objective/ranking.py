@@ -1,13 +1,18 @@
 """LambdaMART ranking objectives (reference: ``src/objective/rank_obj.cu`` —
 ``rank:pairwise``/``rank:ndcg``/``rank:map`` registered at :950-958).
 
-TPU-first design: the reference samples explicit pairs per query group
-(CPU: random pair loops; GPU: SegmentSorter). On TPU we pad each query group
-to a fixed ``max_group_size``, compute ALL pairwise lambdas inside the padded
-[G, S, S] tensor with masking, and weight by |delta metric| for the
-ndcg/map variants — an all-pairs formulation that is a better fit for the
-MXU than sampling, and equivalent to the reference with
-``num_pairsample -> inf`` normalization.
+TPU-first design, two regimes:
+
+- small groups: pad each query group to ``max_group_size`` and compute ALL
+  pairwise lambdas inside a masked [G, S, S] tensor — MXU-friendly,
+  equivalent to the reference with ``num_pairsample -> inf``.
+- large groups (MSLR-WEB30K-class, 1000+ docs/query): the cubic tensor is
+  hundreds of GB, so pairs are SAMPLED the way the reference's
+  ``rank_obj.cu:143-198`` segmented sampler does — every document draws
+  ``lambdarank_num_pair_per_sample`` opponents uniformly from its group
+  (mismatched labels kept), ranks/IDCG come from one global lexsort instead
+  of padding, and both pair ends receive their lambda. Peak memory is
+  O(n * num_pair), independent of group size.
 """
 
 from __future__ import annotations
@@ -83,6 +88,78 @@ def _lambda_grad(
     return grad, hess
 
 
+# all-pairs only while G * S^2 stays under this many elements; above it the
+# sampled-pair path keeps memory O(n * num_pair) (rank_obj.cu:143-198)
+_ALL_PAIRS_BUDGET = 1 << 25
+
+
+@partial(jax.jit, static_argnames=("n_groups", "n_pair", "scheme"))
+def _lambda_grad_sampled(
+    margin: jax.Array,  # [n]
+    label: jax.Array,  # [n]
+    group_of: jax.Array,  # [n] int32
+    group_start: jax.Array,  # [n] int32 (start row of own group)
+    group_size: jax.Array,  # [n] int32 (own group's size)
+    key: jax.Array,
+    n_groups: int,
+    n_pair: int,
+    scheme: str,
+) -> Tuple[jax.Array, jax.Array]:
+    """Sampled-pair LambdaMART without any [G, S] padding: per-group ranks
+    and IDCG come from one global lexsort keyed (group, -margin)."""
+    n = margin.shape[0]
+    # ranks within group by current margin
+    order = jnp.lexsort((-margin, group_of))
+    pos_sorted = jnp.zeros((n,), jnp.int32).at[order].set(
+        jnp.arange(n, dtype=jnp.int32))
+    rank = pos_sorted - group_start  # 0-based rank inside own group
+
+    gains = 2.0 ** label - 1.0
+    disc = 1.0 / jnp.log2(rank.astype(margin.dtype) + 2.0)
+    if scheme == "ndcg":
+        # IDCG per group: labels sorted descending within group
+        lorder = jnp.lexsort((-label, group_of))
+        lrank = (jnp.zeros((n,), jnp.int32).at[lorder].set(
+            jnp.arange(n, dtype=jnp.int32)) - group_start)
+        ideal_terms = gains / jnp.log2(lrank.astype(margin.dtype) + 2.0)
+        idcg = jax.ops.segment_sum(ideal_terms, group_of,
+                                   num_segments=n_groups)
+        idcg_row = jnp.maximum(idcg[group_of], 1e-10)  # [n]
+
+    # opponents: j uniform in own group, n_pair draws per row
+    u = jax.random.uniform(key, (n, n_pair))
+    j_local = jnp.minimum((u * group_size[:, None]).astype(jnp.int32),
+                          group_size[:, None] - 1)
+    j = group_start[:, None] + j_local  # [n, P] global row ids
+    m_j = margin[j]
+    y_j = label[j]
+    valid = label[:, None] != y_j
+
+    # orient each pair: hi = higher label
+    i_is_hi = label[:, None] > y_j
+    s_hi = jnp.where(i_is_hi, margin[:, None], m_j)
+    s_lo = jnp.where(i_is_hi, m_j, margin[:, None])
+    rho = jax.nn.sigmoid(-(s_hi - s_lo))
+    if scheme == "ndcg":
+        g_j = gains[j]
+        d_j = disc[j]
+        delta = (jnp.abs(gains[:, None] - g_j)
+                 * jnp.abs(disc[:, None] - d_j) / idcg_row[:, None])
+        w_pair = jnp.where(valid, delta, 0.0)
+    else:
+        w_pair = jnp.where(valid, 1.0, 0.0)
+    lam = rho * w_pair  # pushes hi up, lo down
+    hes = jnp.maximum(rho * (1.0 - rho), 1e-16) * w_pair
+
+    sign_i = jnp.where(i_is_hi, -1.0, 1.0)  # hi gets -lambda
+    grad = (sign_i * lam).sum(axis=1)
+    hess = hes.sum(axis=1)
+    # the opponent end of every pair gets the mirrored update
+    grad = grad.at[j.reshape(-1)].add((-sign_i * lam).reshape(-1))
+    hess = hess.at[j.reshape(-1)].add(hes.reshape(-1))
+    return grad, jnp.maximum(hess, 1e-16)
+
+
 class _LambdaRankBase(ObjFunction):
     task = Task.RANKING
     scheme = "pairwise"
@@ -95,11 +172,25 @@ class _LambdaRankBase(ObjFunction):
         n_groups = len(sizes)
         max_size = int(sizes.max(initial=1))
         group_of = np.repeat(np.arange(n_groups, dtype=np.int32), sizes)
-        rank_in_group = np.concatenate([np.arange(s, dtype=np.int32) for s in sizes]) if n else np.zeros(0, np.int32)
-        grad, hess = _lambda_grad(
-            margin, label, jnp.asarray(group_of), jnp.asarray(rank_in_group),
-            n_groups, max_size, self.scheme,
-        )
+        if n_groups * max_size * max_size > _ALL_PAIRS_BUDGET:
+            n_pair = max(1, int(getattr(self.params,
+                                        "lambdarank_num_pair_per_sample", 1)))
+            starts = np.asarray(group_ptr[:-1], np.int32)
+            grad, hess = _lambda_grad_sampled(
+                margin, label, jnp.asarray(group_of),
+                jnp.asarray(starts[group_of]),
+                jnp.asarray(sizes.astype(np.int32)[group_of]),
+                jax.random.PRNGKey(iteration * 2654435761 & 0x7FFFFFFF),
+                n_groups, n_pair, self.scheme,
+            )
+        else:
+            rank_in_group = np.concatenate(
+                [np.arange(s, dtype=np.int32) for s in sizes]
+            ) if n else np.zeros(0, np.int32)
+            grad, hess = _lambda_grad(
+                margin, label, jnp.asarray(group_of), jnp.asarray(rank_in_group),
+                n_groups, max_size, self.scheme,
+            )
         # per-group query weights (reference: weights are per-group for ranking)
         if weight is not None and len(weight) == n_groups:
             w_row = jnp.asarray(np.repeat(np.asarray(weight), sizes))

@@ -1,9 +1,14 @@
 from .mesh import (  # noqa: F401
     current_mesh,
+    init_distributed,
     make_mesh,
     mesh_context,
     pad_to_multiple,
     shard_rows,
 )
-from .grow import distributed_grow_tree, distributed_grow_tree_lossguide  # noqa: F401
+from .grow import (  # noqa: F401
+    distributed_grow_tree,
+    distributed_grow_tree_fused,
+    distributed_grow_tree_lossguide,
+)
 from .sketch import distributed_compute_cuts  # noqa: F401

@@ -52,6 +52,36 @@ def mesh_context(mesh: Optional[Mesh]) -> Iterator[None]:
         _state.mesh = prev
 
 
+def init_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    local_device_ids: Optional[Sequence[int]] = None,
+) -> Mesh:
+    """Multi-host entry point — the role the reference's dask frontend plays
+    (``python-package/xgboost/dask.py:838-952``: start RabitTracker, hand
+    every worker its rank/URI, build the rabit ring). Single-controller JAX
+    collapses all of that to ``jax.distributed.initialize`` + one mesh over
+    every process's devices; DCN transport is handled by the runtime, and
+    there is no tracker because the mesh IS the membership.
+
+    Call once per process before building DMatrix/Booster objects, then
+    train inside ``mesh_context(mesh)`` with each process ingesting its own
+    row shard (the ``load_row_split`` analog — see
+    ``docs/distributed.md``). Arguments mirror
+    ``jax.distributed.initialize`` and may be omitted when the runtime
+    auto-detects (TPU pods). Returns the global mesh.
+    """
+    if num_processes is not None and num_processes > 1:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+            local_device_ids=local_device_ids,
+        )
+    return make_mesh(devices=jax.devices())
+
+
 def pad_to_multiple(n: int, k: int) -> int:
     return ((n + k - 1) // k) * k
 

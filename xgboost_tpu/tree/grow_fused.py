@@ -39,8 +39,10 @@ import numpy as np
 from .grow import (
     GrowParams,
     _sample_features_exact,
+    apply_row_sampling,
     child_bounds_and_weights,
     eval_splits,
+    exact_k_subset,
     interaction_allowed,
 )
 from .hist_kernel import TR, fused_level, leaf_delta, partition_apply_xla
@@ -97,10 +99,7 @@ def grow_tree_fused(
     if cfg.axis_name is not None:
         k_sub = jax.random.fold_in(k_sub, jax.lax.axis_index(cfg.axis_name))
 
-    if cfg.subsample < 1.0:
-        keep_r = jax.random.bernoulli(k_sub, cfg.subsample, (n,))
-        grad = jnp.where(keep_r, grad, 0.0)
-        hess = jnp.where(keep_r, hess, 0.0)
+    grad, hess = apply_row_sampling(cfg, k_sub, grad, hess)
     gh = jnp.stack([grad, hess], axis=-1)  # [n, 2]
 
     if cfg.colsample_bytree < 1.0:
@@ -183,14 +182,21 @@ def grow_tree_fused(
             node_lo = jax.lax.dynamic_slice_in_dim(lo_b, off, K)
             node_up = jax.lax.dynamic_slice_in_dim(up_b, off, K)
 
+        # hierarchical EXACT-k column sampling: each stage draws an exact
+        # subset nested in its parent set (random.h:120 ColumnSampler)
+        k_tree = max(1, int(round(cfg.colsample_bytree * F))) \
+            if cfg.colsample_bytree < 1.0 else F
         fmask = tree_mask
         if cfg.colsample_bylevel < 1.0:
-            kl = jax.random.fold_in(k_level, d)
-            fmask = fmask & jax.random.bernoulli(kl, cfg.colsample_bylevel, (F,))
+            k_lvl = max(1, int(round(cfg.colsample_bylevel * k_tree)))
+            fmask = exact_k_subset(jax.random.fold_in(k_level, d), fmask, k_lvl)
+        else:
+            k_lvl = k_tree
         if cfg.colsample_bynode < 1.0:
+            k_nd = max(1, int(round(cfg.colsample_bynode * k_lvl)))
             kn = jax.random.fold_in(jax.random.fold_in(k_level, d), 1)
-            node_fmask = fmask[None, :] & jax.random.bernoulli(
-                kn, cfg.colsample_bynode, (K, F)
+            node_fmask = exact_k_subset(
+                kn, jnp.broadcast_to(fmask[None, :], (K, F)), k_nd
             )
         else:
             node_fmask = jnp.broadcast_to(fmask[None, :], (K, F))

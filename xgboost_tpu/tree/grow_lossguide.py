@@ -93,10 +93,9 @@ def grow_tree_lossguide(
         # decorrelate row sampling across shards; feature sampling keys stay
         # shared (see grow.py — reference random.h:146 invariant)
         k_sub = jax.random.fold_in(k_sub, jax.lax.axis_index(cfg.axis_name))
-    if cfg.subsample < 1.0:
-        keep = jax.random.bernoulli(k_sub, cfg.subsample, (n,))
-        grad = jnp.where(keep, grad, 0.0)
-        hess = jnp.where(keep, hess, 0.0)
+    from .grow import apply_row_sampling
+
+    grad, hess = apply_row_sampling(cfg, k_sub, grad, hess)
     if cfg.colsample_bytree < 1.0:
         tree_fmask = _sample_features_exact(
             k_ctree, F, cfg.colsample_bytree, feature_weights
@@ -129,21 +128,24 @@ def grow_tree_lossguide(
         return blocked_histogram(bins32, gh, side, 2, MB, cfg.axis_name)
 
     def node_masks(node_ids, depths, used_rows):
-        """[K, F] feature mask for a batch of nodes (colsample bylevel via
-        depth fold, bynode via node-id fold, interaction via used masks)."""
+        """[K, F] feature mask for a batch of nodes: hierarchical EXACT-k
+        column sampling (random.h:120 — bylevel keyed by depth, bynode by
+        node id, each nested in its parent set), then interaction masks."""
+        from .grow import exact_k_subset
+
+        k_tree = max(1, int(round(cfg.colsample_bytree * F))) \
+            if cfg.colsample_bytree < 1.0 else F
         fm = jnp.broadcast_to(tree_fmask[None, :], (node_ids.shape[0], F))
         if cfg.colsample_bylevel < 1.0:
+            k_lvl = max(1, int(round(cfg.colsample_bylevel * k_tree)))
             keys = jax.vmap(lambda dd: jax.random.fold_in(k_node, dd))(depths)
-            bern = jax.vmap(
-                lambda kk: jax.random.bernoulli(kk, cfg.colsample_bylevel, (F,))
-            )(keys)
-            fm = fm & bern
+            fm = jax.vmap(lambda kk, m: exact_k_subset(kk, m, k_lvl))(keys, fm)
+        else:
+            k_lvl = k_tree
         if cfg.colsample_bynode < 1.0:
+            k_nd = max(1, int(round(cfg.colsample_bynode * k_lvl)))
             keys = jax.vmap(lambda nid: jax.random.fold_in(jax.random.fold_in(k_node, nid), 1))(node_ids)
-            bern = jax.vmap(
-                lambda kk: jax.random.bernoulli(kk, cfg.colsample_bynode, (F,))
-            )(keys)
-            fm = fm & bern
+            fm = jax.vmap(lambda kk, m: exact_k_subset(kk, m, k_nd))(keys, fm)
         if cfg.has_interaction:
             fm = fm & interaction_allowed(used_rows, gmask)
         return fm

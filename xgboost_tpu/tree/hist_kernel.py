@@ -230,17 +230,25 @@ def fused_level(bins, pos, gh, ptab, *, K, Kp, B, d, pallas: bool):
 
 def leaf_delta(pos, leaf_values, max_nodes_pad: int, pallas: bool):
     """Prediction-cache delta: ``leaf_values[pos]`` for every row, as an
-    exact hi/lo one-hot matmul (TPU) or a plain gather (CPU). This is the
+    exact one-hot matmul (TPU) or a plain gather (CPU). Leaf values are
+    split into THREE bf16 terms (24 significand bits = exact f32) so the
+    cache never drifts from the materialized model. This is the
     UpdatePredictionCache fast path (reference ``gbtree.cc:219``)."""
     p = pos[:, 0]
     if not pallas:
         return leaf_values[jnp.clip(p, 0, leaf_values.shape[0] - 1)]
     lv = jnp.zeros((max_nodes_pad,), jnp.float32).at[:leaf_values.shape[0]].set(leaf_values)
-    hi = jax.lax.bitcast_convert_type(
-        jax.lax.bitcast_convert_type(lv, jnp.int32) & _MASK_HI, jnp.float32)
-    lo = lv - hi
-    tab = jnp.stack([hi, lo], axis=1).astype(jnp.bfloat16)  # [P, 2]
+
+    def bf_mask(x):
+        return jax.lax.bitcast_convert_type(
+            jax.lax.bitcast_convert_type(x, jnp.int32) & _MASK_HI, jnp.float32)
+
+    hi = bf_mask(lv)
+    r = lv - hi
+    mid = bf_mask(r)
+    lo = r - mid
+    tab = jnp.stack([hi, mid, lo], axis=1).astype(jnp.bfloat16)  # [P, 3]
     oh = jax.nn.one_hot(p, max_nodes_pad, dtype=jnp.bfloat16)
     out = jax.lax.dot_general(oh, tab, (((1,), (0,)), ((), ())),
-                              preferred_element_type=jnp.float32)  # [n, 2]
-    return out[:, 0] + out[:, 1]
+                              preferred_element_type=jnp.float32)  # [n, 3]
+    return out[:, 0] + out[:, 1] + out[:, 2]
