@@ -7,6 +7,7 @@ import os
 import subprocess
 import sys
 
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -241,3 +242,64 @@ def test_small_F_colsample_never_empty():
     from xgboost_tpu.metric import create_metric
     auc = float(create_metric("auc").evaluate(bst.predict(d), y))
     assert auc > 0.7
+
+
+def test_segmented_rank_metrics_match_per_group_oracle():
+    """Vectorized ndcg@/map@/pre@/grouped-AUC must equal a straightforward
+    per-group implementation."""
+    from xgboost_tpu.metric import create_metric
+
+    rng = np.random.RandomState(5)
+    sizes = rng.randint(1, 40, 60)
+    gptr = np.concatenate([[0], np.cumsum(sizes)])
+    n = int(gptr[-1])
+    p = rng.randn(n).astype(np.float32)
+    y = rng.randint(0, 4, n).astype(np.float32)
+
+    def oracle_ndcg(k):
+        vals = []
+        for g in range(len(sizes)):
+            lo, hi = gptr[g], gptr[g + 1]
+            o = np.argsort(-p[lo:hi], kind="stable")
+            r = y[lo:hi][o][:k]
+            dcg = ((2.0 ** r - 1) / np.log2(np.arange(len(r)) + 2)).sum()
+            i = np.sort(y[lo:hi])[::-1][:k]
+            idcg = ((2.0 ** i - 1) / np.log2(np.arange(len(i)) + 2)).sum()
+            vals.append(dcg / idcg if idcg > 0 else 1.0)
+        return np.mean(vals)
+
+    def oracle_map(k):
+        vals = []
+        for g in range(len(sizes)):
+            lo, hi = gptr[g], gptr[g + 1]
+            o = np.argsort(-p[lo:hi], kind="stable")
+            rel = (y[lo:hi][o] > 0).astype(float)[:k]
+            if rel.sum() == 0:
+                vals.append(1.0)
+                continue
+            prec = np.cumsum(rel) / (np.arange(len(rel)) + 1)
+            vals.append((prec * rel).sum() / rel.sum())
+        return np.mean(vals)
+
+    for k in (5, 10):
+        m = create_metric(f"ndcg@{k}")
+        got = float(m.evaluate(jnp.asarray(p), jnp.asarray(y), group_ptr=gptr))
+        assert abs(got - oracle_ndcg(k)) < 1e-9, (got, oracle_ndcg(k))
+        m2 = create_metric(f"map@{k}")
+        got2 = float(m2.evaluate(jnp.asarray(p), jnp.asarray(y), group_ptr=gptr))
+        assert abs(got2 - oracle_map(k)) < 1e-9
+
+    # grouped AUC vs per-group binary AUC
+    from xgboost_tpu.metric.auc import _binary_auc
+    yb = (y > 1).astype(np.float32)
+    m3 = create_metric("auc")
+    got3 = float(m3.evaluate(jnp.asarray(p), jnp.asarray(yb), group_ptr=gptr))
+    vals = []
+    for g in range(len(sizes)):
+        lo, hi = gptr[g], gptr[g + 1]
+        ylg = yb[lo:hi]
+        if hi <= lo or ylg.min(initial=1) == ylg.max(initial=0):
+            continue
+        vals.append(float(_binary_auc(jnp.asarray(p[lo:hi]), jnp.asarray(ylg),
+                                      jnp.ones(hi - lo, np.float32))))
+    assert abs(got3 - np.mean(vals)) < 1e-6

@@ -111,3 +111,37 @@ def test_lossguide_beats_shallow_depthwise_on_imbalanced_structure():
     pred = bst.predict(d)
     acc = ((pred > 0.5) == y).mean()
     assert acc > 0.99
+
+
+def test_lossguide_batched_expansion_quality():
+    """max_leaves > 64 takes the batched top-8 expansion path; the model
+    must still fit well and respect the leaf budget."""
+    rng = np.random.RandomState(0)
+    n, F = 6000, 10
+    X = rng.randn(n, F).astype(np.float32)
+    y = (np.nan_to_num(X) @ rng.randn(F) + 0.3 * rng.randn(n) > 0).astype(np.float32)
+    d = xgb.DMatrix(X, label=y)
+    bst = xgb.train({"objective": "binary:logistic", "grow_policy": "lossguide",
+                     "max_leaves": 100, "max_depth": 0, "eta": 0.3}, d, 5,
+                    verbose_eval=False)
+    from xgboost_tpu.metric import create_metric
+    auc = float(create_metric("auc").evaluate(bst.predict(d), y))
+    assert auc > 0.9, auc
+    for t in bst._gbm.model.trees:
+        assert t.num_leaves <= 100
+
+
+def test_lossguide_batched_reaches_leaf_budget():
+    """The batched expansion must not under-build: with rich continuous
+    targets every split has positive gain, so the tree should reach the
+    full max_leaves budget (guards the queue ramp-up accounting)."""
+    rng = np.random.RandomState(1)
+    n, F = 20000, 10
+    X = rng.randn(n, F).astype(np.float32)
+    y = rng.randn(n).astype(np.float32)  # noise: gain > 0 everywhere
+    d = xgb.DMatrix(X, label=y)
+    bst = xgb.train({"objective": "reg:squarederror", "grow_policy": "lossguide",
+                     "max_leaves": 100, "max_depth": 0, "reg_lambda": 0.0},
+                    d, 1, verbose_eval=False)
+    t = bst._gbm.model.trees[0]
+    assert t.num_leaves == 100, t.num_leaves

@@ -2,6 +2,8 @@
 test_hist_util.cc)."""
 
 import numpy as np
+
+import xgboost_tpu as xgb
 import pytest
 
 from xgboost_tpu.data.quantile import BinnedMatrix, bin_matrix, compute_cuts
@@ -60,3 +62,72 @@ def test_all_missing_feature():
     X[:, 0] = np.arange(50)
     bm = BinnedMatrix.from_dense(X, max_bin=4)
     assert np.all(np.asarray(bm.bins)[:, 1] == 4)
+
+
+def test_streaming_quantile_dmatrix_actually_streams():
+    """Peak host memory for 2-pass ingest must be ~one batch + bins: after
+    construction no full float copy exists until something asks for raw
+    values (VERDICT r2 item 9; reference IterativeDeviceDMatrix property,
+    iterative_device_dmatrix.h:81)."""
+    from xgboost_tpu.data.iterator import DataIter, StreamingQuantileDMatrix
+
+    rng = np.random.RandomState(0)
+    parts = [rng.randn(500, 6).astype(np.float32) for _ in range(4)]
+    labels = [(p.sum(1) > 0).astype(np.float32) for p in parts]
+
+    class It(DataIter):
+        def __init__(self):
+            super().__init__()
+            self.i = 0
+
+        def reset(self):
+            self.i = 0
+
+        def next(self, input_data):
+            if self.i >= len(parts):
+                return 0
+            input_data(data=parts[self.i], label=labels[self.i])
+            self.i += 1
+            return 1
+
+    d = StreamingQuantileDMatrix(It(), max_bin=32)
+    assert d._data is None, "raw floats must not be retained after ingest"
+    assert d.num_row() == 2000 and d.num_col() == 6
+    # training runs on bins only — _data stays None through a full train
+    bst = xgb.train({"objective": "binary:logistic", "max_depth": 3,
+                     "max_bin": 32}, d, 3, verbose_eval=False)
+    assert d._data is None, "training must not materialize raw floats"
+    # predict reconstructs representative values lazily and stays sane
+    pred = bst.predict(d)
+    assert np.isfinite(pred).all()
+    from xgboost_tpu.metric import create_metric
+    auc = float(create_metric("auc").evaluate(pred, np.concatenate(labels)))
+    assert auc > 0.75, auc
+
+
+def test_streaming_dmatrix_rebin_at_other_max_bin():
+    """Training with a max_bin different from the constructor's must rebuild
+    bins from lazily reconstructed values rather than crash on the absent
+    raw-float copy."""
+    from xgboost_tpu.data.iterator import DataIter, StreamingQuantileDMatrix
+
+    rng = np.random.RandomState(1)
+    parts = [rng.randn(400, 5).astype(np.float32) for _ in range(2)]
+    labels = [(p.sum(1) > 0).astype(np.float32) for p in parts]
+
+    class It(DataIter):
+        def __init__(self):
+            super().__init__(); self.i = 0
+        def reset(self):
+            self.i = 0
+        def next(self, input_data):
+            if self.i >= len(parts):
+                return 0
+            input_data(data=parts[self.i], label=labels[self.i]); self.i += 1
+            return 1
+
+    d = StreamingQuantileDMatrix(It(), max_bin=32)
+    # default max_bin=256 misses the prebuilt cache -> rebin path
+    bst = xgb.train({"objective": "binary:logistic", "max_depth": 3}, d, 2,
+                    verbose_eval=False)
+    assert np.isfinite(bst.predict(d)).all()

@@ -153,7 +153,7 @@ class DMatrix:
         return int(self._data.shape[1])
 
     def num_nonmissing(self) -> int:
-        return int(np.count_nonzero(~np.isnan(self._data)))
+        return int(np.count_nonzero(~np.isnan(self.data)))
 
     @property
     def data(self) -> np.ndarray:
@@ -205,7 +205,7 @@ class DMatrix:
                 from ..parallel.mesh import pad_to_multiple, shard_rows
                 from ..parallel.sketch import distributed_compute_cuts
 
-                X = np.asarray(self._data, np.float32)
+                X = np.asarray(self.data, np.float32)
                 n_pad = pad_to_multiple(X.shape[0], mesh.devices.size)
                 if n_pad != X.shape[0]:
                     X = np.concatenate(
@@ -227,7 +227,7 @@ class DMatrix:
 
                     apply_categorical_identity(cuts.values, cuts.min_vals, cat)
             bm = BinnedMatrix.from_dense(
-                self._data, max_bin=max_bin, weights=sketch_weights,
+                self.data, max_bin=max_bin, weights=sketch_weights,
                 categorical=cat, cuts=cuts,
             )
             self._binned[max_bin] = bm
@@ -240,7 +240,7 @@ class DMatrix:
         reference likewise validates categories, common/categorical.h
         InvalidCat checks)."""
         for f in cat:
-            col = self._data[:, f]
+            col = self.data[:, f]
             valid = col[~np.isnan(col)]
             if valid.size == 0:
                 continue
@@ -258,7 +258,7 @@ class DMatrix:
     def slice(self, rindex: Any) -> "DMatrix":
         rindex = np.asarray(rindex)
         out = DMatrix.__new__(DMatrix)
-        out._data = self._data[rindex]
+        out._data = np.asarray(self.data)[rindex]
         out.info = self.info.slice(rindex)
         out._binned = {}
         return out

@@ -315,7 +315,12 @@ class Booster:
                 entry.margin = entry.margin + _pm(sub, dmat.data, zero)
                 entry.num_trees = hi
             return entry.margin
-        margin = self._gbm.predict(dmat.data, base)
+        if cur == 0:
+            # empty model: don't touch dmat.data (streaming matrices
+            # reconstruct raw values lazily — the zero-tree margin is base)
+            margin = base
+        else:
+            margin = self._gbm.predict(dmat.data, base)
         if entry is not None and self._gbm.name == "gbtree":
             entry.margin = margin
             entry.num_trees = cur

@@ -40,6 +40,42 @@ def _binary_auc(score: jax.Array, label: jax.Array, weight: jax.Array) -> jax.Ar
     return jnp.where((Wp > 0) & (Wn > 0), num / jnp.maximum(Wp * Wn, 1e-30), jnp.nan)
 
 
+@partial(jax.jit, static_argnames=("n_groups",))
+def _grouped_auc(score, label, weight, group_of, n_groups):
+    """Per-group binary AUCs, averaged over groups that have both classes —
+    segmented version of ``_binary_auc`` (one lexsort + segment_sums; the
+    reference's GPU path, auc.cu, structures it the same way)."""
+    n = score.shape[0]
+    order = jnp.lexsort((score, group_of))
+    g = group_of[order]
+    s = score[order]
+    y = label[order]
+    w = weight[order]
+    wp = w * y
+    wn = w * (1.0 - y)
+    newblk = jnp.concatenate(
+        [jnp.ones((1,), bool), (s[1:] != s[:-1]) | (g[1:] != g[:-1])]
+    )
+    seg = jnp.cumsum(newblk) - 1
+    blk_wn = jax.ops.segment_sum(wn, seg, num_segments=n)
+    cum_blk = jnp.concatenate([jnp.zeros((1,)), jnp.cumsum(blk_wn)[:-1]])[seg]
+    Wn_g = jax.ops.segment_sum(wn, g, num_segments=n_groups)
+    grp_before = jnp.concatenate(
+        [jnp.zeros((1,)), jnp.cumsum(Wn_g)[:-1]]
+    )[g]
+    below = cum_blk - grp_before  # negative weight strictly below, in-group
+    tied = blk_wn[seg]
+    num_g = jax.ops.segment_sum(wp * (below + 0.5 * tied), g,
+                                num_segments=n_groups)
+    Wp_g = jax.ops.segment_sum(wp, g, num_segments=n_groups)
+    valid = (Wp_g > 0) & (Wn_g > 0)
+    auc_g = num_g / jnp.maximum(Wp_g * Wn_g, 1e-30)
+    cnt = valid.sum()
+    return jnp.where(cnt > 0,
+                     jnp.where(valid, auc_g, 0.0).sum() / jnp.maximum(cnt, 1),
+                     jnp.nan)
+
+
 @METRICS.register("auc")
 class AUC(Metric):
     name = "auc"
@@ -63,18 +99,13 @@ class AUC(Metric):
         if preds.ndim == 2:
             preds = preds[:, 0]
         if group_ptr is not None and len(group_ptr) > 2:
-            # ranking: mean of per-group AUCs, groups without both classes skipped
-            vals = []
-            pr = np.asarray(preds)
-            lb = np.asarray(label_j)
-            wn = np.asarray(w)
-            for g in range(len(group_ptr) - 1):
-                lo, hi = int(group_ptr[g]), int(group_ptr[g + 1])
-                yl = lb[lo:hi]
-                if yl.min(initial=1) == yl.max(initial=0):
-                    continue
-                vals.append(float(_binary_auc(jnp.asarray(pr[lo:hi]), jnp.asarray(yl), jnp.asarray(wn[lo:hi]))))
-            return float(np.mean(vals)) if vals else float("nan")
+            # ranking: mean of per-group AUCs in ONE segmented program
+            # (auc.cc:262-313 / auc.cu segmented scans) — no per-group
+            # device calls
+            sizes = np.diff(np.asarray(group_ptr)).astype(np.int64)
+            group_of = np.repeat(np.arange(len(sizes), dtype=np.int32), sizes)
+            return float(_grouped_auc(preds, (label_j > 0).astype(jnp.float32),
+                                      w, jnp.asarray(group_of), len(sizes)))
         return float(_binary_auc(preds, label_j, w))
 
 

@@ -17,6 +17,21 @@ def _groups(n: int, group_ptr: Optional[np.ndarray]):
     return np.asarray(group_ptr)
 
 
+def _segmented_layout(p: np.ndarray, y: np.ndarray, ptr: np.ndarray):
+    """Shared segmented machinery: ONE global lexsort by (group, -score)
+    instead of a Python argsort per group (the reference's GPU rank metrics
+    use segmented sorts the same way, rank_metric.cu / dh::SegmentSorter).
+    Returns (sorted y, group id per sorted row, local rank per sorted row,
+    group sizes)."""
+    sizes = np.diff(ptr).astype(np.int64)
+    G = len(sizes)
+    group_of = np.repeat(np.arange(G, dtype=np.int64), sizes)
+    order = np.lexsort((-p, group_of))
+    starts = np.asarray(ptr[:-1], np.int64)
+    local = np.arange(len(y), dtype=np.int64) - starts[group_of]
+    return y[order], group_of, local, sizes
+
+
 class _PerGroupMetric(Metric):
     maximize = True
 
@@ -25,60 +40,70 @@ class _PerGroupMetric(Metric):
         if full_name:
             self.name = full_name
 
-    def group_score(self, order_desc: np.ndarray, label: np.ndarray) -> float:
+    def group_scores(self, ys, group_of, local, sizes, k) -> np.ndarray:
         raise NotImplementedError
 
     def evaluate(self, preds, label, weight=None, group_ptr=None, **kw):
         p = np.asarray(preds).reshape(-1)
-        y = np.asarray(label)
+        y = np.asarray(label, np.float64)
         ptr = _groups(len(y), group_ptr)
-        scores = []
-        for g in range(len(ptr) - 1):
-            lo, hi = int(ptr[g]), int(ptr[g + 1])
-            if hi <= lo:
-                continue
-            order = np.argsort(-p[lo:hi], kind="stable")
-            scores.append(self.group_score(order, y[lo:hi]))
-        return float(np.mean(scores)) if scores else float("nan")
+        ys, group_of, local, sizes = _segmented_layout(p, y, ptr)
+        k = self.topn if self.topn > 0 else int(sizes.max(initial=0))
+        scores = self.group_scores(ys, group_of, local, sizes, k)
+        scores = scores[sizes > 0]
+        return float(scores.mean()) if len(scores) else float("nan")
 
 
 @METRICS.register("ndcg@", "ndcg")
 class NDCG(_PerGroupMetric):
     name = "ndcg"
 
-    def group_score(self, order, y):
-        k = self.topn if self.topn > 0 else len(y)
-        ranked = y[order][:k]
-        gains = 2.0 ** ranked - 1.0
-        discounts = 1.0 / np.log2(np.arange(len(ranked)) + 2.0)
-        dcg = float((gains * discounts).sum())
-        ideal = np.sort(y)[::-1][:k]
-        idcg = float(((2.0 ** ideal - 1.0) * (1.0 / np.log2(np.arange(len(ideal)) + 2.0))).sum())
-        return dcg / idcg if idcg > 0 else 1.0
+    def group_scores(self, ys, group_of, local, sizes, k):
+        G = len(sizes)
+        disc = 1.0 / np.log2(local + 2.0)
+        top = local < k
+        dcg = np.bincount(group_of, weights=(2.0 ** ys - 1.0) * disc * top,
+                          minlength=G)
+        # ideal order: labels sorted descending within group
+        lorder = np.lexsort((-ys, group_of))
+        yi = ys[lorder]
+        idcg = np.bincount(group_of, weights=(2.0 ** yi - 1.0) * disc * top,
+                           minlength=G)
+        return np.where(idcg > 0, dcg / np.maximum(idcg, 1e-30), 1.0)
 
 
 @METRICS.register("map@", "map")
 class MAP(_PerGroupMetric):
     name = "map"
 
-    def group_score(self, order, y):
-        k = self.topn if self.topn > 0 else len(y)
-        rel = (y[order] > 0).astype(np.float64)[:k]
-        if rel.sum() == 0:
-            return 1.0  # reference counts no-positive groups as 1
-        hits = np.cumsum(rel)
-        prec = hits / (np.arange(len(rel)) + 1.0)
-        return float((prec * rel).sum() / rel.sum())
+    def group_scores(self, ys, group_of, local, sizes, k):
+        G = len(sizes)
+        rel = (ys > 0).astype(np.float64)
+        cs = np.cumsum(rel)
+        starts_sorted = local == 0
+        base = np.repeat(cs[starts_sorted] - rel[starts_sorted],
+                         sizes[sizes > 0])
+        hits = cs - base  # positives at-or-above each row, within group
+        top = local < k
+        prec_terms = np.where(top, hits / (local + 1.0) * rel, 0.0)
+        num = np.bincount(group_of, weights=prec_terms, minlength=G)
+        den = np.bincount(group_of, weights=rel * top, minlength=G)
+        return np.where(den > 0, num / np.maximum(den, 1e-30), 1.0)
 
 
 @METRICS.register("pre@", "pre")
 class PrecisionAt(_PerGroupMetric):
     name = "pre"
 
-    def group_score(self, order, y):
-        k = self.topn if self.topn > 0 else len(y)
-        rel = (y[order] > 0)[:k]
-        return float(rel.sum() / max(k, 1))
+    def group_scores(self, ys, group_of, local, sizes, k):
+        G = len(sizes)
+        rel = (ys > 0) & (local < k)
+        hits = np.bincount(group_of, weights=rel.astype(np.float64),
+                           minlength=G)
+        if self.topn > 0:  # pre@n divides by the fixed n (rank_metric.cc)
+            return hits / max(k, 1)
+        # bare "pre": per-group precision over the whole group
+        return hits / np.maximum(sizes, 1)
 
 
 @METRICS.register("ams@")

@@ -203,7 +203,7 @@ def _pred_kernel(x_ref, tab_ref, ohg_ref, out_ref, *, T, Np, F, G, steps):
     nanmask = jnp.isnan(xc)
     xsafe = jnp.where(nanmask, 0.0, xc)
 
-    UB = 8 if T % 8 == 0 else 1  # python-level unroll inside the fori body
+    UB = 4 if T % 4 == 0 else 1  # python-level unroll inside the fori body
 
     def tree_body(t, acc):
         tab = tab_ref[pl.ds(t, 1), :, :][0]  # [Np, 8] bf16
@@ -257,7 +257,7 @@ def _predict_margin_pallas(X, tab, ohg, steps):
     n, F = X.shape
     T, Np, _ = tab.shape
     G = ohg.shape[1]
-    Tr = 512
+    Tr = 256  # modest row tile: the table + unrolled walk must fit VMEM
     n_pad = -(-n // Tr) * Tr
     if n_pad != n:
         X = jnp.concatenate(
