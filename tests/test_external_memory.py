@@ -1,0 +1,93 @@
+"""External-memory (disk-paged) training (reference: SparsePageDMatrix /
+sparse_page_source.h — cache on disk, pages re-streamed per iteration with
+background prefetch)."""
+
+import numpy as np
+import pytest
+
+import xgboost_tpu as xgb
+from xgboost_tpu.data.iterator import DataIter
+from xgboost_tpu.metric import create_metric
+
+
+class _ArrayIter(DataIter):
+    def __init__(self, parts, labels):
+        super().__init__()
+        self.parts, self.labels, self.i = parts, labels, 0
+
+    def reset(self):
+        self.i = 0
+
+    def next(self, input_data):
+        if self.i >= len(self.parts):
+            return 0
+        input_data(data=self.parts[self.i], label=self.labels[self.i])
+        self.i += 1
+        return 1
+
+
+def _make(n_parts=4, rows=700, F=8, seed=0):
+    rng = np.random.RandomState(seed)
+    w = rng.randn(F)
+    parts, labels = [], []
+    for _ in range(n_parts):
+        X = rng.randn(rows, F).astype(np.float32)
+        parts.append(X)
+        labels.append((X @ w + 0.4 * rng.randn(rows) > 0).astype(np.float32))
+    return parts, labels, w
+
+
+def test_external_memory_trains_matches_incore(tmp_path):
+    parts, labels, w = _make()
+    d_ext = xgb.ExternalMemoryQuantileDMatrix(
+        _ArrayIter(parts, labels), cache_prefix=str(tmp_path / "cache"),
+        max_bin=64, page_rows=1024)  # several pages, unaligned tail
+    assert d_ext.num_row() == 2800
+    params = {"objective": "binary:logistic", "max_depth": 4, "eta": 0.3,
+              "max_bin": 64}
+    bst = xgb.train(params, d_ext, 8, verbose_eval=False)
+
+    # in-core reference on the same data: identical cuts pipeline -> the
+    # paged grower must produce the same quality (trees may differ only
+    # through sketch merge batching, which both paths share)
+    X = np.concatenate(parts)
+    y = np.concatenate(labels)
+    d_in = xgb.DMatrix(X, label=y)
+    bst_in = xgb.train(params, d_in, 8, verbose_eval=False)
+    auc_ext = float(create_metric("auc").evaluate(bst.predict(d_in), y))
+    auc_in = float(create_metric("auc").evaluate(bst_in.predict(d_in), y))
+    assert auc_ext > 0.9
+    assert abs(auc_ext - auc_in) < 0.03, (auc_ext, auc_in)
+
+
+def test_external_memory_page_cache_roundtrip(tmp_path):
+    parts, labels, _ = _make(n_parts=2, rows=300)
+    d = xgb.ExternalMemoryQuantileDMatrix(
+        _ArrayIter(parts, labels), cache_prefix=str(tmp_path / "c"),
+        max_bin=32, page_rows=128)
+    paged = d.get_binned(32, None)
+    assert paged.n_pages == -(-600 // 128)
+    total = 0
+    for k in range(paged.n_pages):
+        page = paged.read_page(k)
+        assert page.shape[1] == 8
+        assert (page <= 32).all()
+        total += page.shape[0]
+    assert total == 600
+    paged.close()
+
+
+def test_external_memory_raw_values_unavailable(tmp_path):
+    parts, labels, _ = _make(n_parts=1, rows=200)
+    d = xgb.ExternalMemoryQuantileDMatrix(
+        _ArrayIter(parts, labels), cache_prefix=str(tmp_path / "c"),
+        max_bin=32)
+    with pytest.raises(NotImplementedError):
+        _ = d.data
+
+
+def test_native_pagecache_builds():
+    from xgboost_tpu.native import get_pagecache_lib
+
+    lib = get_pagecache_lib()
+    assert lib is not None, "native page cache failed to build"

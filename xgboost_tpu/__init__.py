@@ -10,6 +10,7 @@ place of rabit/NCCL AllReduce.
 
 from .config import config_context, get_config, set_config  # noqa: F401
 from .data.dmatrix import DMatrix, QuantileDMatrix  # noqa: F401
+from .data.external import ExternalMemoryQuantileDMatrix  # noqa: F401
 from .learner import Booster  # noqa: F401
 from .training import cv, train  # noqa: F401
 from . import callback  # noqa: F401
@@ -22,6 +23,7 @@ __version__ = "0.1.0"
 __all__ = [
     "DMatrix",
     "QuantileDMatrix",
+    "ExternalMemoryQuantileDMatrix",
     "Booster",
     "train",
     "cv",

@@ -379,6 +379,12 @@ class GBTree:
         if not lossguide_pol and not cats:
             return self._boost_fused(binned, grad, hess, iteration,
                                      margin_cache, feature_weights)
+        if getattr(binned, "is_paged", False):
+            raise NotImplementedError(
+                "external-memory matrices support depthwise numerical "
+                "training only (reference external memory has the same "
+                "hist-only restriction)"
+            )
         if cats:
             # one-hot vs optimal-partition gate (reference UseOneHot,
             # evaluate_splits.h: one-hot when n_cats < max_cat_to_onehot)
@@ -575,40 +581,62 @@ class GBTree:
         mesh = current_mesh()
         use_mesh = mesh is not None and mesh.devices.size > 1
         n = binned.n_rows
-        if use_mesh:
-            from ..parallel.grow import distributed_grow_tree_fused
-
-            binsf, n_pad = binned.fused_bins_mesh(mesh)
-        else:
-            binsf, n_pad = binned.fused_bins()
         cut_vals = jnp.asarray(binned.cuts.values)
         fw = (jnp.asarray(feature_weights)
               if feature_weights is not None else None)
+        paged = getattr(binned, "is_paged", False)
+        if paged and use_mesh:
+            raise NotImplementedError(
+                "external-memory + mesh training is not supported yet; "
+                "shard rows across processes instead (docs/distributed.md)"
+            )
+        if paged:
+            from ..tree.grow_fused import grow_tree_fused_paged
+
+            def grow_one(g, h, key):
+                return grow_tree_fused_paged(
+                    binned, g, h, cut_vals, key,
+                    float(tp.eta), float(tp.gamma), cfg,
+                    feature_weights=fw,
+                )
+        elif use_mesh:
+            from ..parallel.grow import distributed_grow_tree_fused
+
+            binsf, n_pad = binned.fused_bins_mesh(mesh)
+
+            def grow_one(g, h, key):
+                if n_pad != n:
+                    pad = jnp.zeros((n_pad - n,), jnp.float32)
+                    g = jnp.concatenate([g, pad])
+                    h = jnp.concatenate([h, pad])
+                g, h = shard_rows(g, mesh), shard_rows(h, mesh)
+                return distributed_grow_tree_fused(
+                    mesh, binsf, g, h, cut_vals, key,
+                    jnp.float32(tp.eta), jnp.float32(tp.gamma), cfg, fw,
+                )
+        else:
+            binsf, n_pad = binned.fused_bins()
+
+            def grow_one(g, h, key):
+                if n_pad != n:
+                    pad = jnp.zeros((n_pad - n,), jnp.float32)
+                    g = jnp.concatenate([g, pad])
+                    h = jnp.concatenate([h, pad])
+                return grow_tree_fused(
+                    binsf, g, h, cut_vals, key,
+                    float(tp.eta), float(tp.gamma), cfg, fw,
+                )
+
         new_trees = []
         for k in range(self.n_groups):
             g = grad[:, k] if grad.ndim == 2 else grad
             h = hess[:, k] if hess.ndim == 2 else hess
-            if n_pad != n:
-                pad = jnp.zeros((n_pad - n,), jnp.float32)
-                g = jnp.concatenate([g, pad])
-                h = jnp.concatenate([h, pad])
-            if use_mesh:
-                g, h = shard_rows(g, mesh), shard_rows(h, mesh)
             for ptree in range(self.gbtree_param.num_parallel_tree):
                 key = jax.random.PRNGKey(
                     (tp.seed * 1000003 + iteration * 131 + k * 17 + ptree)
                     & 0x7FFFFFFF
                 )
-                if use_mesh:
-                    grown = distributed_grow_tree_fused(
-                        mesh, binsf, g, h, cut_vals, key,
-                        jnp.float32(tp.eta), jnp.float32(tp.gamma), cfg, fw,
-                    )
-                else:
-                    grown = grow_tree_fused(
-                        binsf, g, h, cut_vals, key,
-                        float(tp.eta), float(tp.gamma), cfg, fw,
-                    )
+                grown = grow_one(g, h, key)
                 self.model.add_device(grown, tp.eta, k, tp.max_depth)
                 new_trees.append(grown)
                 if margin_cache is not None:

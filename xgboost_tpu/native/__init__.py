@@ -19,10 +19,14 @@ import numpy as np
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "fastparse.cpp")
 _LIB_PATH = os.path.join(_HERE, "libfastparse.so")
+_PC_SRC = os.path.join(_HERE, "pagecache.cpp")
+_PC_LIB = os.path.join(_HERE, "libpagecache.so")
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
+_pc_lib: Optional[ctypes.CDLL] = None
+_pc_tried = False
 
 
 def _build() -> bool:
@@ -32,6 +36,44 @@ def _build() -> bool:
         return True
     except Exception:
         return False
+
+
+def get_pagecache_lib() -> Optional[ctypes.CDLL]:
+    """Load (building on demand) the native page cache; None if unavailable
+    (callers fall back to plain numpy file IO)."""
+    global _pc_lib, _pc_tried
+    with _lock:
+        if _pc_lib is not None or _pc_tried:
+            return _pc_lib
+        _pc_tried = True
+        if not os.path.exists(_PC_LIB) or (
+            os.path.exists(_PC_SRC)
+            and os.path.getmtime(_PC_SRC) > os.path.getmtime(_PC_LIB)
+        ):
+            cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+                   "-o", _PC_LIB, _PC_SRC]
+            try:
+                subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+            except Exception:
+                return None
+        try:
+            lib = ctypes.CDLL(_PC_LIB)
+        except OSError:
+            return None
+        lib.pc_write.argtypes = [ctypes.c_char_p, ctypes.c_void_p,
+                                 ctypes.c_longlong]
+        lib.pc_write.restype = ctypes.c_int
+        lib.pc_open.argtypes = [ctypes.c_char_p, ctypes.c_longlong,
+                                ctypes.POINTER(ctypes.c_longlong),
+                                ctypes.c_int]
+        lib.pc_open.restype = ctypes.c_void_p
+        lib.pc_read.argtypes = [ctypes.c_void_p, ctypes.c_longlong,
+                                ctypes.c_void_p]
+        lib.pc_read.restype = ctypes.c_int
+        lib.pc_close.argtypes = [ctypes.c_void_p]
+        lib.pc_close.restype = None
+        _pc_lib = lib
+        return _pc_lib
 
 
 def get_lib() -> Optional[ctypes.CDLL]:

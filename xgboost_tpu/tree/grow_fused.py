@@ -69,9 +69,224 @@ class GrownTree(NamedTuple):
     delta: jax.Array  # f32 [n_padded] margin increment (training rows)
 
 
+class _HeapState(NamedTuple):
+    """Per-tree heap arrays threaded through the level loop (all
+    [max_nodes] except the constraint extras)."""
+
+    is_split: jax.Array
+    feature: jax.Array
+    split_bin: jax.Array
+    split_cond: jax.Array
+    default_left: jax.Array
+    node_g: jax.Array
+    node_h: jax.Array
+    node_w: jax.Array
+    loss_chg: jax.Array
+    lo_b: jax.Array  # [max_nodes] or [1] when unconstrained
+    up_b: jax.Array
+    used: jax.Array  # [max_nodes, F] or [1, F]
+    ptab: jax.Array  # [K, 4] previous level's decisions
+
+
 def pad_rows(n: int) -> int:
     """Rows padded to the kernel tile size."""
     return -(-n // TR) * TR
+
+
+def _constraint_consts(cfg: GrowParams, F: int):
+    mono_j = gmask = None
+    if cfg.has_monotone:
+        mono_np = np.zeros(F, np.int32)
+        mono_np[: len(cfg.monotone)] = cfg.monotone[:F]
+        mono_j = jnp.asarray(mono_np)
+    if cfg.has_interaction:
+        gmask_np = np.zeros((len(cfg.interaction), F), bool)
+        for gi, grp in enumerate(cfg.interaction):
+            for f in grp:
+                if f < F:
+                    gmask_np[gi, f] = True
+        gmask = jnp.asarray(gmask_np)
+    return mono_j, gmask
+
+
+def _init_state(cfg: GrowParams, F: int, G0, H0) -> _HeapState:
+    max_nodes = cfg.max_nodes
+    p = cfg.split
+    z = lambda dt: jnp.zeros((max_nodes,), dt)  # noqa: E731
+    nb = max_nodes if cfg.has_monotone else 1
+    nu = max_nodes if cfg.has_interaction else 1
+    return _HeapState(
+        is_split=z(bool), feature=z(jnp.int32), split_bin=z(jnp.int32),
+        split_cond=z(jnp.float32), default_left=z(bool),
+        node_g=z(jnp.float32).at[0].set(G0),
+        node_h=z(jnp.float32).at[0].set(H0),
+        node_w=z(jnp.float32).at[0].set(calc_weight(G0, H0, p)),
+        loss_chg=z(jnp.float32),
+        lo_b=jnp.full((nb,), -_INF), up_b=jnp.full((nb,), _INF),
+        used=jnp.zeros((nu, F), bool),
+        ptab=jnp.zeros((1, 4), jnp.float32),
+    )
+
+
+def _level_update(
+    st: _HeapState,
+    histC: jax.Array,  # [F, 2K, B] (missing excluded)
+    cut_values: jax.Array,
+    tree_mask: jax.Array,  # [F] colsample_bytree mask
+    k_level: jax.Array,  # PRNG key for bylevel/bynode draws
+    cfg: GrowParams,
+    d: int,
+) -> _HeapState:
+    """Evaluate level ``d``'s splits from its histogram and write the heap
+    arrays + the next partition table. Shared by the in-core single-program
+    grower and the external-memory paged driver."""
+    F = tree_mask.shape[0]
+    B = cut_values.shape[1]
+    p = cfg.split
+    max_nodes = cfg.max_nodes
+    K = 1 << d
+    off = K - 1
+    mono_j, gmask = _constraint_consts(cfg, F)
+
+    Gtot = jax.lax.dynamic_slice_in_dim(st.node_g, off, K)
+    Htot = jax.lax.dynamic_slice_in_dim(st.node_h, off, K)
+
+    hg = jnp.transpose(histC[:, :K, :], (1, 0, 2))  # [K, F, B]
+    hh = jnp.transpose(histC[:, K:, :], (1, 0, 2))
+    g_miss = Gtot[:, None] - hg.sum(-1)
+    h_miss = Htot[:, None] - hh.sum(-1)
+    hist = jnp.stack(
+        [
+            jnp.concatenate([hg, g_miss[..., None]], axis=-1),
+            jnp.concatenate([hh, h_miss[..., None]], axis=-1),
+        ],
+        axis=-1,
+    )  # [K, F, B+1, 2]
+
+    if cfg.has_monotone:
+        node_lo = jax.lax.dynamic_slice_in_dim(st.lo_b, off, K)
+        node_up = jax.lax.dynamic_slice_in_dim(st.up_b, off, K)
+
+    k_tree = max(1, int(round(cfg.colsample_bytree * F))) \
+        if cfg.colsample_bytree < 1.0 else F
+    fmask = tree_mask
+    if cfg.colsample_bylevel < 1.0:
+        k_lvl = max(1, int(round(cfg.colsample_bylevel * k_tree)))
+        fmask = exact_k_subset(jax.random.fold_in(k_level, d), fmask, k_lvl)
+    else:
+        k_lvl = k_tree
+    if cfg.colsample_bynode < 1.0:
+        k_nd = max(1, int(round(cfg.colsample_bynode * k_lvl)))
+        kn = jax.random.fold_in(jax.random.fold_in(k_level, d), 1)
+        node_fmask = exact_k_subset(
+            kn, jnp.broadcast_to(fmask[None, :], (K, F)), k_nd
+        )
+    else:
+        node_fmask = jnp.broadcast_to(fmask[None, :], (K, F))
+    if cfg.has_interaction:
+        node_used = jax.lax.dynamic_slice_in_dim(st.used, off, K, axis=0)
+        node_fmask = node_fmask & interaction_allowed(node_used, gmask)
+
+    dec = eval_splits(
+        hist, Gtot, Htot, p, node_fmask, B,
+        mono=mono_j if cfg.has_monotone else None,
+        node_lo=node_lo if cfg.has_monotone else None,
+        node_up=node_up if cfg.has_monotone else None,
+    )
+    can_split = (dec.loss > RT_EPS) & (Htot > 0.0)
+    GLb, HLb = dec.GL, dec.HL
+    GRb, HRb = Gtot - GLb, Htot - HLb
+    cond = cut_values[dec.f, dec.b]
+
+    slots = off + jnp.arange(K)
+    is_split = st.is_split.at[slots].set(can_split)
+    feature = st.feature.at[slots].set(dec.f)
+    split_bin = st.split_bin.at[slots].set(dec.b)
+    split_cond = st.split_cond.at[slots].set(cond)
+    default_left = st.default_left.at[slots].set(dec.dir == 1)
+    node_w = st.node_w.at[slots].set(dec.w_node)
+    loss_chg = st.loss_chg.at[slots].set(jnp.where(can_split, dec.loss, 0.0))
+
+    if cfg.has_monotone:
+        l_lo, l_up, r_lo, r_up, wl_c, wr_c = child_bounds_and_weights(
+            p, mono_j[dec.f], GLb, HLb, GRb, HRb, node_lo, node_up
+        )
+    else:
+        wl_c = calc_weight(GLb, HLb, p)
+        wr_c = calc_weight(GRb, HRb, p)
+
+    lidx = jnp.where(can_split, 2 * slots + 1, max_nodes)
+    ridx = jnp.where(can_split, 2 * slots + 2, max_nodes)
+    node_g = st.node_g.at[lidx].set(GLb, mode="drop").at[ridx].set(GRb, mode="drop")
+    node_h = st.node_h.at[lidx].set(HLb, mode="drop").at[ridx].set(HRb, mode="drop")
+    node_w = node_w.at[lidx].set(wl_c, mode="drop").at[ridx].set(wr_c, mode="drop")
+    lo_b, up_b, used = st.lo_b, st.up_b, st.used
+    if cfg.has_monotone:
+        lo_b = lo_b.at[lidx].set(l_lo, mode="drop").at[ridx].set(r_lo, mode="drop")
+        up_b = up_b.at[lidx].set(l_up, mode="drop").at[ridx].set(r_up, mode="drop")
+    if cfg.has_interaction:
+        child_used = jax.lax.dynamic_slice_in_dim(used, off, K, axis=0) | (
+            jax.nn.one_hot(dec.f, F, dtype=bool)
+        )
+        used = used.at[lidx].set(child_used, mode="drop")
+        used = used.at[ridx].set(child_used, mode="drop")
+
+    ptab = jnp.stack(
+        [
+            can_split.astype(jnp.float32),
+            dec.f.astype(jnp.float32),
+            dec.b.astype(jnp.float32),
+            (dec.dir == 1).astype(jnp.float32),
+        ],
+        axis=1,
+    )  # [K, 4]
+    return _HeapState(
+        is_split=is_split, feature=feature, split_bin=split_bin,
+        split_cond=split_cond, default_left=default_left,
+        node_g=node_g, node_h=node_h, node_w=node_w, loss_chg=loss_chg,
+        lo_b=lo_b, up_b=up_b, used=used, ptab=ptab,
+    )
+
+
+def _finalize(st: _HeapState, eta, gamma, cfg: GrowParams):
+    """Gamma pruning (bottom-up, updater_prune.cc) + governing leaf value
+    per heap node; shared by both drivers."""
+    max_depth = cfg.max_depth
+    max_nodes = cfg.max_nodes
+    keep = st.is_split
+    child_keep = jnp.zeros((1 << max_depth,), bool)
+    for d in range(max_depth - 1, -1, -1):
+        w = 1 << d
+        off = w - 1
+        isl = jax.lax.dynamic_slice_in_dim(st.is_split, off, w)
+        lcl = jax.lax.dynamic_slice_in_dim(st.loss_chg, off, w)
+        child_any = child_keep[0::2] | child_keep[1::2]
+        keep_l = isl & ((lcl >= gamma) | child_any)
+        keep = jax.lax.dynamic_update_slice_in_dim(keep, keep_l, off, axis=0)
+        child_keep = keep_l
+
+    leaf_value = jnp.zeros((max_nodes,), jnp.float32)
+    root_open = keep[0]
+    gov = jnp.where(root_open, 0.0, eta * st.node_w[0])[None]
+    gov_open = root_open[None]
+    leaf_value = leaf_value.at[0].set(gov[0])
+    for d in range(1, max_depth + 1):
+        w = 1 << d
+        off = w - 1
+        parent_gov = jnp.repeat(gov, 2)
+        parent_open = jnp.repeat(gov_open, 2)
+        own_w = jax.lax.dynamic_slice_in_dim(st.node_w, off, w)
+        if d < max_depth:
+            node_keep = jax.lax.dynamic_slice_in_dim(keep, off, w)
+        else:
+            node_keep = jnp.zeros((w,), bool)
+        gov = jnp.where(parent_open,
+                        jnp.where(node_keep, 0.0, eta * own_w), parent_gov)
+        gov_open = parent_open & node_keep
+        leaf_value = jax.lax.dynamic_update_slice_in_dim(
+            leaf_value, gov, off, axis=0
+        )
+    return keep, leaf_value
 
 
 @functools.partial(jax.jit, static_argnames=("cfg",))
@@ -109,204 +324,40 @@ def grow_tree_fused(
     else:
         tree_mask = jnp.ones((F,), bool)
 
-    if cfg.has_monotone:
-        mono_np = np.zeros(F, np.int32)
-        mono_np[: len(cfg.monotone)] = cfg.monotone[:F]
-        mono_j = jnp.asarray(mono_np)
-    if cfg.has_interaction:
-        gmask_np = np.zeros((len(cfg.interaction), F), bool)
-        for gi, grp in enumerate(cfg.interaction):
-            for f in grp:
-                if f < F:
-                    gmask_np[gi, f] = True
-        gmask = jnp.asarray(gmask_np)
-
-    # ---- heap state ----
-    is_split = jnp.zeros((max_nodes,), bool)
-    feature = jnp.zeros((max_nodes,), jnp.int32)
-    split_bin = jnp.zeros((max_nodes,), jnp.int32)
-    split_cond = jnp.zeros((max_nodes,), jnp.float32)
-    default_left = jnp.zeros((max_nodes,), bool)
-    node_g = jnp.zeros((max_nodes,), jnp.float32)
-    node_h = jnp.zeros((max_nodes,), jnp.float32)
-    node_w = jnp.zeros((max_nodes,), jnp.float32)
-    loss_chg = jnp.zeros((max_nodes,), jnp.float32)
-    if cfg.has_monotone:
-        lo_b = jnp.full((max_nodes,), -_INF)
-        up_b = jnp.full((max_nodes,), _INF)
-    if cfg.has_interaction:
-        used = jnp.zeros((max_nodes, F), bool)
-
     # root totals (the InitRoot AllReduce site)
     G0 = grad.sum()
     H0 = hess.sum()
     if cfg.axis_name is not None:
         G0 = jax.lax.psum(G0, cfg.axis_name)
         H0 = jax.lax.psum(H0, cfg.axis_name)
-    node_g = node_g.at[0].set(G0)
-    node_h = node_h.at[0].set(H0)
-    node_w = node_w.at[0].set(calc_weight(G0, H0, p))
+    st = _init_state(cfg, F, G0, H0)
 
     pos = jnp.zeros((n, 1), jnp.int32)
-    ptab = jnp.zeros((1, 4), jnp.float32)
-
     for d in range(max_depth):
         K = 1 << d
         Kp = K >> 1  # previous level width (0 at the root)
-        off = K - 1
-
         pos, histC = fused_level(
-            bins, pos, gh, ptab, K=K, Kp=Kp, B=B, d=d, pallas=pallas
+            bins, pos, gh, st.ptab, K=K, Kp=Kp, B=B, d=d, pallas=pallas
         )  # histC: [F, 2K, B], missing excluded
         if cfg.axis_name is not None:
             histC = jax.lax.psum(histC, cfg.axis_name)
-
-        # node totals from the parent recursion (exact, no data pass)
-        Gtot = jax.lax.dynamic_slice_in_dim(node_g, off, K)
-        Htot = jax.lax.dynamic_slice_in_dim(node_h, off, K)
-
-        # [K, F, B+1, 2] eval layout; missing bin = total - sum(present)
-        hg = jnp.transpose(histC[:, :K, :], (1, 0, 2))  # [K, F, B]
-        hh = jnp.transpose(histC[:, K:, :], (1, 0, 2))
-        g_miss = Gtot[:, None] - hg.sum(-1)  # [K, F]
-        h_miss = Htot[:, None] - hh.sum(-1)
-        hist = jnp.stack(
-            [
-                jnp.concatenate([hg, g_miss[..., None]], axis=-1),
-                jnp.concatenate([hh, h_miss[..., None]], axis=-1),
-            ],
-            axis=-1,
-        )  # [K, F, B+1, 2]
-
-        if cfg.has_monotone:
-            node_lo = jax.lax.dynamic_slice_in_dim(lo_b, off, K)
-            node_up = jax.lax.dynamic_slice_in_dim(up_b, off, K)
-
-        # hierarchical EXACT-k column sampling: each stage draws an exact
-        # subset nested in its parent set (random.h:120 ColumnSampler)
-        k_tree = max(1, int(round(cfg.colsample_bytree * F))) \
-            if cfg.colsample_bytree < 1.0 else F
-        fmask = tree_mask
-        if cfg.colsample_bylevel < 1.0:
-            k_lvl = max(1, int(round(cfg.colsample_bylevel * k_tree)))
-            fmask = exact_k_subset(jax.random.fold_in(k_level, d), fmask, k_lvl)
-        else:
-            k_lvl = k_tree
-        if cfg.colsample_bynode < 1.0:
-            k_nd = max(1, int(round(cfg.colsample_bynode * k_lvl)))
-            kn = jax.random.fold_in(jax.random.fold_in(k_level, d), 1)
-            node_fmask = exact_k_subset(
-                kn, jnp.broadcast_to(fmask[None, :], (K, F)), k_nd
-            )
-        else:
-            node_fmask = jnp.broadcast_to(fmask[None, :], (K, F))
-        if cfg.has_interaction:
-            node_used = jax.lax.dynamic_slice_in_dim(used, off, K, axis=0)
-            node_fmask = node_fmask & interaction_allowed(node_used, gmask)
-
-        dec = eval_splits(
-            hist, Gtot, Htot, p, node_fmask, B,
-            mono=mono_j if cfg.has_monotone else None,
-            node_lo=node_lo if cfg.has_monotone else None,
-            node_up=node_up if cfg.has_monotone else None,
-        )
-        can_split = (dec.loss > RT_EPS) & (Htot > 0.0)
-        GLb, HLb = dec.GL, dec.HL
-        GRb, HRb = Gtot - GLb, Htot - HLb
-        cond = cut_values[dec.f, dec.b]
-
-        slots = off + jnp.arange(K)
-        is_split = is_split.at[slots].set(can_split)
-        feature = feature.at[slots].set(dec.f)
-        split_bin = split_bin.at[slots].set(dec.b)
-        split_cond = split_cond.at[slots].set(cond)
-        default_left = default_left.at[slots].set(dec.dir == 1)
-        node_w = node_w.at[slots].set(dec.w_node)
-        loss_chg = loss_chg.at[slots].set(jnp.where(can_split, dec.loss, 0.0))
-
-        if cfg.has_monotone:
-            l_lo, l_up, r_lo, r_up, wl_c, wr_c = child_bounds_and_weights(
-                p, mono_j[dec.f], GLb, HLb, GRb, HRb, node_lo, node_up
-            )
-        else:
-            wl_c = calc_weight(GLb, HLb, p)
-            wr_c = calc_weight(GRb, HRb, p)
-
-        lidx = jnp.where(can_split, 2 * slots + 1, max_nodes)
-        ridx = jnp.where(can_split, 2 * slots + 2, max_nodes)
-        node_g = node_g.at[lidx].set(GLb, mode="drop").at[ridx].set(GRb, mode="drop")
-        node_h = node_h.at[lidx].set(HLb, mode="drop").at[ridx].set(HRb, mode="drop")
-        node_w = node_w.at[lidx].set(wl_c, mode="drop").at[ridx].set(wr_c, mode="drop")
-        if cfg.has_monotone:
-            lo_b = lo_b.at[lidx].set(l_lo, mode="drop").at[ridx].set(r_lo, mode="drop")
-            up_b = up_b.at[lidx].set(l_up, mode="drop").at[ridx].set(r_up, mode="drop")
-        if cfg.has_interaction:
-            child_used = jax.lax.dynamic_slice_in_dim(used, off, K, axis=0) | (
-                jax.nn.one_hot(dec.f, F, dtype=bool)
-            )
-            used = used.at[lidx].set(child_used, mode="drop")
-            used = used.at[ridx].set(child_used, mode="drop")
-
-        ptab = jnp.stack(
-            [
-                can_split.astype(jnp.float32),
-                dec.f.astype(jnp.float32),
-                dec.b.astype(jnp.float32),
-                (dec.dir == 1).astype(jnp.float32),
-            ],
-            axis=1,
-        )  # [K, 4]
+        st = _level_update(st, histC, cut_values, tree_mask, k_level, cfg, d)
 
     # ---- route rows through the last level's splits to their leaves ----
     if max_depth > 0:
         pos = partition_apply_xla(
-            bins, pos, ptab, Kp=1 << (max_depth - 1), B=B, d=max_depth
+            bins, pos, st.ptab, Kp=1 << (max_depth - 1), B=B, d=max_depth
         )
 
-    # ---- gamma pruning, bottom-up (updater_prune.cc semantics) ----
-    keep = is_split
-    child_keep = jnp.zeros((1 << max_depth,), bool)
-    for d in range(max_depth - 1, -1, -1):
-        w = 1 << d
-        off = w - 1
-        isl = jax.lax.dynamic_slice_in_dim(is_split, off, w)
-        lcl = jax.lax.dynamic_slice_in_dim(loss_chg, off, w)
-        child_any = child_keep[0::2] | child_keep[1::2]
-        keep_l = isl & ((lcl >= gamma) | child_any)
-        keep = jax.lax.dynamic_update_slice_in_dim(keep, keep_l, off, axis=0)
-        child_keep = keep_l
-
-    # ---- leaf values: governing (pruned) leaf value for every heap node ----
-    leaf_value = jnp.zeros((max_nodes,), jnp.float32)
-    root_open = keep[0]
-    gov = jnp.where(root_open, 0.0, eta * node_w[0])[None]  # [1]
-    gov_open = root_open[None]
-    leaf_value = leaf_value.at[0].set(gov[0])
-    for d in range(1, max_depth + 1):
-        w = 1 << d
-        off = w - 1
-        parent_gov = jnp.repeat(gov, 2)
-        parent_open = jnp.repeat(gov_open, 2)
-        own_w = jax.lax.dynamic_slice_in_dim(node_w, off, w)
-        if d < max_depth:
-            node_keep = jax.lax.dynamic_slice_in_dim(keep, off, w)
-        else:
-            node_keep = jnp.zeros((w,), bool)
-        gov = jnp.where(parent_open,
-                        jnp.where(node_keep, 0.0, eta * own_w), parent_gov)
-        gov_open = parent_open & node_keep
-        leaf_value = jax.lax.dynamic_update_slice_in_dim(
-            leaf_value, gov, off, axis=0
-        )
-
+    keep, leaf_value = _finalize(st, eta, gamma, cfg)
     pad_nodes = max(128, 1 << (max_nodes - 1).bit_length())
     delta = leaf_delta(pos, leaf_value, pad_nodes, pallas=pallas)
 
     return GrownTree(
-        keep=keep, feature=feature, split_bin=split_bin, split_cond=split_cond,
-        default_left=default_left, node_g=node_g, node_h=node_h,
-        node_weight=node_w, loss_chg=loss_chg, leaf_value=leaf_value,
-        delta=delta,
+        keep=keep, feature=st.feature, split_bin=st.split_bin,
+        split_cond=st.split_cond, default_left=st.default_left,
+        node_g=st.node_g, node_h=st.node_h, node_weight=st.node_w,
+        loss_chg=st.loss_chg, leaf_value=leaf_value, delta=delta,
     )
 
 
@@ -314,3 +365,122 @@ def _pallas_flag(cfg: GrowParams) -> bool:
     from .hist_kernel import use_pallas
 
     return use_pallas() and cfg.axis_name is None
+
+
+# jitted views of the shared level machinery for the paged (out-of-core)
+# driver, which runs the level loop in Python so pages can stream from disk
+_level_update_jit = jax.jit(_level_update, static_argnames=("cfg", "d"))
+_finalize_jit = jax.jit(_finalize, static_argnames=("cfg",))
+
+
+@functools.partial(jax.jit, static_argnames=("Kp", "B", "d", "pallas",
+                                             "pad_nodes"))
+def _page_delta(bins, pos, ptab, leaf_value, *, Kp, B, d, pallas, pad_nodes):
+    pos = partition_apply_xla(bins, pos, ptab, Kp=Kp, B=B, d=d)
+    return leaf_delta(pos, leaf_value, pad_nodes, pallas=pallas)
+
+
+def grow_tree_fused_paged(
+    paged,  # data.external.PagedBins
+    grad: np.ndarray,  # [n] host or device
+    hess: np.ndarray,
+    cut_values: jax.Array,
+    key: jax.Array,
+    eta: float,
+    gamma: float,
+    cfg: GrowParams,
+    feature_weights: Optional[jax.Array] = None,
+) -> GrownTree:
+    """Out-of-core variant of ``grow_tree_fused``: the level loop runs in
+    Python, streaming quantized pages from the disk cache (prefetched by the
+    native pager) and accumulating the fixed-size level histogram across
+    pages — the reference's external-memory training loop
+    (``sparse_page_source.h``: re-stream pages every iteration, window
+    prefetched). Device memory holds ONE page of bins plus per-page row
+    positions/gradients; the histogram/eval machinery is byte-identical to
+    the in-core path (shared ``_level_update``/``_finalize``)."""
+    assert cfg.axis_name is None, "paged + mesh not supported yet"
+    assert not cfg.has_categorical
+    B = cut_values.shape[1]
+    F = paged.n_features
+    n = paged.n_rows
+    P = paged.n_pages
+    pr_pad = pad_rows(paged.page_rows)
+    pallas = _pallas_flag(cfg)
+    missing_bin = B
+
+    k_sub, k_ctree, k_level = jax.random.split(key, 3)
+    grad = jnp.asarray(grad, jnp.float32)
+    hess = jnp.asarray(hess, jnp.float32)
+
+    gh_pages = []
+    for k in range(P):
+        lo = k * paged.page_rows
+        r = paged.rows_of(k)
+        g = jax.lax.dynamic_slice_in_dim(grad, lo, r) if r == paged.page_rows \
+            else grad[lo:lo + r]
+        h = jax.lax.dynamic_slice_in_dim(hess, lo, r) if r == paged.page_rows \
+            else hess[lo:lo + r]
+        g, h = apply_row_sampling(cfg, jax.random.fold_in(k_sub, k), g, h)
+        if r != pr_pad:
+            pad = jnp.zeros((pr_pad - r,), jnp.float32)
+            g = jnp.concatenate([g, pad])
+            h = jnp.concatenate([h, pad])
+        gh_pages.append(jnp.stack([g, h], axis=-1))
+
+    if cfg.colsample_bytree < 1.0:
+        tree_mask = _sample_features_exact(
+            k_ctree, F, cfg.colsample_bytree, feature_weights
+        )
+    else:
+        tree_mask = jnp.ones((F,), bool)
+
+    G0 = sum(gh[:, 0].sum() for gh in gh_pages)
+    H0 = sum(gh[:, 1].sum() for gh in gh_pages)
+    st = _init_state(cfg, F, G0, H0)
+    pos_pages = [jnp.zeros((pr_pad, 1), jnp.int32) for _ in range(P)]
+
+    def page_bins(k: int) -> jax.Array:
+        arr = paged.read_page(k)
+        if arr.shape[0] != pr_pad:
+            pad = np.full((pr_pad - arr.shape[0], F), missing_bin, arr.dtype)
+            arr = np.concatenate([arr, pad])
+        return jnp.asarray(arr.astype(np.int32))
+
+    for d in range(cfg.max_depth):
+        K = 1 << d
+        Kp = K >> 1
+        hist = jnp.zeros((F, 2 * K, B), jnp.float32)
+        for k in range(P):
+            pos_k, hist_k = fused_level(
+                page_bins(k), pos_pages[k], gh_pages[k], st.ptab,
+                K=K, Kp=Kp, B=B, d=d, pallas=pallas,
+            )
+            pos_pages[k] = pos_k
+            hist = hist + hist_k
+        st = _level_update_jit(st, hist, cut_values, tree_mask, k_level,
+                               cfg=cfg, d=d)
+
+    keep, leaf_value = _finalize_jit(st, jnp.float32(eta), jnp.float32(gamma),
+                                     cfg=cfg)
+    pad_nodes = max(128, 1 << (cfg.max_nodes - 1).bit_length())
+    deltas = []
+    for k in range(P):
+        if cfg.max_depth > 0:
+            dlt = _page_delta(
+                page_bins(k), pos_pages[k], st.ptab, leaf_value,
+                Kp=1 << (cfg.max_depth - 1), B=B, d=cfg.max_depth,
+                pallas=pallas, pad_nodes=pad_nodes,
+            )
+        else:
+            dlt = leaf_delta(pos_pages[k], leaf_value, pad_nodes,
+                             pallas=pallas)
+        deltas.append(dlt[: paged.rows_of(k)])
+    delta = jnp.concatenate(deltas)
+
+    return GrownTree(
+        keep=keep, feature=st.feature, split_bin=st.split_bin,
+        split_cond=st.split_cond, default_left=st.default_left,
+        node_g=st.node_g, node_h=st.node_h, node_weight=st.node_w,
+        loss_chg=st.loss_chg, leaf_value=leaf_value, delta=delta,
+    )
