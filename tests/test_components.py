@@ -303,3 +303,30 @@ def test_segmented_rank_metrics_match_per_group_oracle():
         vals.append(float(_binary_auc(jnp.asarray(p[lo:hi]), jnp.asarray(ylg),
                                       jnp.ones(hi - lo, np.float32))))
     assert abs(got3 - np.mean(vals)) < 1e-6
+
+
+def test_arrow_table_adapter():
+    pa = pytest.importorskip("pyarrow")
+    rng = np.random.RandomState(0)
+    df_np = rng.randn(200, 3).astype(np.float32)
+    table = pa.table({f"f{i}": df_np[:, i] for i in range(3)})
+    d = xgb.DMatrix(table, label=(df_np.sum(1) > 0).astype(np.float32))
+    assert d.num_row() == 200 and d.num_col() == 3
+    np.testing.assert_allclose(np.asarray(d.data), df_np, rtol=1e-6)
+
+
+def test_load_row_split_partitions_disjoint():
+    import tempfile, os
+    rows = ["1 0:1.5 1:2.0", "0 0:0.5", "1 1:3.0", "0 0:2.5 1:1.0", "1 0:9.0"]
+    with tempfile.NamedTemporaryFile("w", suffix=".libsvm", delete=False) as f:
+        f.write("\n".join(rows) + "\n")
+        path = f.name
+    try:
+        parts = [xgb.load_row_split(path, r, 2) for r in range(2)]
+        assert parts[0].num_row() + parts[1].num_row() == 5
+        y0 = parts[0].info.label
+        y1 = parts[1].info.label
+        full = xgb.DMatrix(path).info.label
+        assert sorted(np.concatenate([y0, y1]).tolist()) == sorted(full.tolist())
+    finally:
+        os.unlink(path)

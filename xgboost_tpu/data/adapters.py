@@ -137,6 +137,12 @@ def dispatch_data(
             X, label, qid = load_svmlight(path)
     elif hasattr(data, "tocsr"):  # scipy sparse
         X, feature_names = _from_scipy(data, missing)
+    elif type(data).__module__.startswith("pyarrow"):  # arrow Table/batch
+        # reference: arrow adapter in data.py dispatch — go through pandas
+        # (zero-copy for primitive columns)
+        df = data.to_pandas()
+        X, feature_names, feature_types = _from_pandas(df, missing,
+                                                       enable_categorical)
     elif hasattr(data, "columns") and hasattr(data, "dtypes"):  # pandas
         X, feature_names, feature_types = _from_pandas(data, missing, enable_categorical)
     else:

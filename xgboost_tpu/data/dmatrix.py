@@ -292,3 +292,26 @@ class QuantileDMatrix(DMatrix):
             self._data, max_bin=max_bin, weights=self.info.weight, cuts=cuts,
             categorical=cat,
         )
+
+
+def load_row_split(uri, rank: int, world: int, **kwargs) -> "DMatrix":
+    """Load a rank's row shard of a text dataset — the multi-process
+    ingestion helper for distributed training (reference:
+    ``DMatrix::Load(..., load_row_split=true)`` /
+    ``include/xgboost/data.h:512``: every worker parses the file and keeps
+    the rows of its rank, round-robin by block). Use with
+    ``parallel.init_distributed`` (docs/distributed.md)."""
+    if not (0 <= rank < world):
+        raise ValueError(f"rank {rank} outside [0, {world})")
+    d = DMatrix(uri, **kwargs)
+    if world == 1:
+        return d
+    idx = np.arange(rank, d.num_row(), world)
+    out = d.slice(idx)
+    # per-group data cannot be row-split blindly (reference raises too)
+    if d.info.group_ptr is not None and len(d.info.group_ptr) > 2:
+        raise ValueError(
+            "load_row_split cannot split grouped (ranking) data; "
+            "shard by query group instead"
+        )
+    return out
