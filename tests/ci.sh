@@ -1,0 +1,22 @@
+#!/bin/bash
+# CI entry point (reference analog: Jenkinsfile / .github workflows +
+# sanitizer builds, CMakeLists.txt:61-64). Three tiers:
+#   1. standard suite on the virtual 8-device CPU mesh
+#   2. debug_nans pass over the numeric core (the jax analog of
+#      ASan/UBSan: any NaN produced inside a jitted program raises)
+#   3. x64 parity spot-check (sketch/histogram math stable when jax
+#      promotes to float64 — catches accidental precision dependence)
+set -e
+cd "$(dirname "$0")/.."
+export JAX_PLATFORMS=cpu
+unset PALLAS_AXON_POOL_IPS
+
+echo "=== tier 1: full suite (8-device virtual mesh) ==="
+python -m pytest tests/ -x -q
+
+echo "=== tier 2: debug_nans numeric core ==="
+JAX_DEBUG_NANS=1 python -m pytest tests/test_basic_train.py tests/test_fidelity.py -x -q
+
+echo "=== tier 3: x64 parity spot-check ==="
+JAX_ENABLE_X64=1 python -m pytest tests/test_quantile.py -x -q
+echo "CI OK"

@@ -330,3 +330,51 @@ def test_load_row_split_partitions_disjoint():
         assert sorted(np.concatenate([y0, y1]).tolist()) == sorted(full.tolist())
     finally:
         os.unlink(path)
+
+
+def test_checkpoint_crash_resume_equivalence():
+    """Fault-tolerance story (reference: rabit checkpoint API + mock-based
+    kill tests, allreduce_mock.h; production recovery = restart from the
+    saved model): training interrupted at round 5 and resumed from the
+    checkpoint must reproduce the uninterrupted 10-round model."""
+    rng = np.random.RandomState(0)
+    X = rng.randn(3000, 8).astype(np.float32)
+    y = (np.nan_to_num(X).sum(1) > 0).astype(np.float32)
+    params = {"objective": "binary:logistic", "max_depth": 4, "eta": 0.3}
+
+    d = xgb.DMatrix(X, label=y)
+    full = xgb.train(params, d, 10, verbose_eval=False)
+
+    first = xgb.train(params, d, 5, verbose_eval=False)
+    blob = first.save_raw()  # "crash": only the serialized model survives
+    del first, d
+
+    d2 = xgb.DMatrix(X, label=y)  # fresh process analog
+    restored = xgb.Booster(params)
+    restored.load_model(blob)
+    resumed = xgb.train(params, d2, 5, verbose_eval=False, xgb_model=restored)
+
+    assert resumed.num_boosted_rounds() == 10
+    np.testing.assert_allclose(
+        resumed.predict(d2), full.predict(d2), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_inplace_predict_matches_dmatrix_predict():
+    rng = np.random.RandomState(0)
+    X = rng.randn(1000, 6).astype(np.float32)
+    X[rng.rand(1000, 6) < 0.1] = np.nan
+    y = (np.nan_to_num(X).sum(1) > 0).astype(np.float32)
+    d = xgb.DMatrix(X, label=y)
+    bst = xgb.train({"objective": "binary:logistic", "max_depth": 4}, d, 5,
+                    verbose_eval=False)
+    p1 = bst.predict(xgb.DMatrix(X))
+    p2 = bst.inplace_predict(X)
+    np.testing.assert_allclose(p1, p2, rtol=1e-6)
+    m = bst.inplace_predict(X, predict_type="margin")
+    np.testing.assert_allclose(
+        m, bst.predict(xgb.DMatrix(X), output_margin=True), rtol=1e-6)
+    # missing sentinel handling on the fast path
+    Xs = np.nan_to_num(X, nan=-999.0)
+    p3 = bst.inplace_predict(Xs, missing=-999.0)
+    np.testing.assert_allclose(p1, p3, rtol=1e-6)

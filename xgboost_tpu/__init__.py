@@ -10,6 +10,7 @@ place of rabit/NCCL AllReduce.
 
 from .config import config_context, get_config, set_config  # noqa: F401
 from .data.dmatrix import DMatrix, QuantileDMatrix, load_row_split  # noqa: F401
+from .utils.timer import profiler_context  # noqa: F401
 from .data.external import ExternalMemoryQuantileDMatrix  # noqa: F401
 from .learner import Booster  # noqa: F401
 from .training import cv, train  # noqa: F401
@@ -25,6 +26,7 @@ __all__ = [
     "QuantileDMatrix",
     "ExternalMemoryQuantileDMatrix",
     "load_row_split",
+    "profiler_context",
     "Booster",
     "train",
     "cv",

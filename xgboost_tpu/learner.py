@@ -368,8 +368,42 @@ class Booster:
         return out
 
     def inplace_predict(self, data, iteration_range=None, predict_type="value", missing=np.nan, base_margin=None, validate_features=True, strict_shape=False):
-        """In-place predict from raw arrays, no DMatrix (reference:
-        XGBoosterPredictFromDense c_api.cc:833)."""
+        """In-place predict from raw arrays — no DMatrix, no copy of the
+        input beyond the device transfer (reference:
+        XGBoosterPredictFromDense c_api.cc:833 / the adapter-templated
+        predictors)."""
+        self._configure()
+        fast = (
+            isinstance(data, np.ndarray)
+            and data.ndim == 2
+            and iteration_range is None
+            and self._gbm.name in ("gbtree", "dart")
+        )
+        if fast:
+            X = data
+            if X.dtype != np.float32:
+                X = X.astype(np.float32)
+            if missing is not None and not (
+                isinstance(missing, float) and np.isnan(missing)
+            ):
+                X = np.where(X == missing, np.nan, X)
+            n = X.shape[0]
+            K = self.n_groups
+            if base_margin is not None:
+                base = jnp.asarray(np.asarray(base_margin, np.float32)).reshape(n, K)
+            else:
+                base = jnp.full((n, K), self._base_margin_val, jnp.float32)
+            margin = self._gbm.predict(X, base)
+            if predict_type == "margin":
+                out = margin
+            else:
+                out = self._obj.pred_transform(
+                    margin[:, 0] if K == 1 else margin
+                )
+            out = np.asarray(out)
+            if out.ndim == 2 and out.shape[1] == 1 and not strict_shape:
+                out = out[:, 0]
+            return out
         d = DMatrix(data, missing=missing)
         if base_margin is not None:
             d.set_base_margin(base_margin)

@@ -51,3 +51,23 @@ class Monitor:
             import sys
 
             print(self.report(), file=sys.stderr, flush=True)
+
+
+@contextlib.contextmanager
+def profiler_context(log_dir: str) -> Iterator[None]:
+    """Capture a device profile of everything inside the context — the
+    heavyweight tracing story (reference analog: NVTX ranges gated by
+    USE_NVTX, ``src/common/timer.h:52``; on TPU the native tool is
+    ``jax.profiler``, viewable in TensorBoard/XProf). Composes with the
+    always-on Monitor accumulators::
+
+        with xgboost_tpu.profiler_context("/tmp/prof"):
+            xgb.train(params, dtrain, 50)
+    """
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
