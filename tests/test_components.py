@@ -378,3 +378,29 @@ def test_inplace_predict_matches_dmatrix_predict():
     Xs = np.nan_to_num(X, nan=-999.0)
     p3 = bst.inplace_predict(Xs, missing=-999.0)
     np.testing.assert_allclose(p1, p3, rtol=1e-6)
+
+
+def test_approx_resketeches_per_iteration():
+    """tree_method='approx' rebuilds hessian-weighted cuts every round
+    (updater_histmaker.cc per-iteration proposal) and still learns; its
+    trees differ from hist's once hessians become non-uniform."""
+    rng = np.random.RandomState(0)
+    X = rng.randn(4000, 8).astype(np.float32)
+    y = (np.nan_to_num(X).sum(1) > 0).astype(np.float32)
+    d = xgb.DMatrix(X, label=y)
+    b_approx = xgb.train({"objective": "binary:logistic", "max_depth": 4,
+                          "tree_method": "approx", "max_bin": 32}, d, 6,
+                         verbose_eval=False)
+    from xgboost_tpu.metric import create_metric
+    auc = float(create_metric("auc").evaluate(b_approx.predict(d), y))
+    assert auc > 0.9
+    d2 = xgb.DMatrix(X, label=y)
+    b_hist = xgb.train({"objective": "binary:logistic", "max_depth": 4,
+                        "tree_method": "tpu_hist", "max_bin": 32}, d2, 6,
+                       verbose_eval=False)
+    # round-0 hessians are uniform (logistic at base 0.5): identical cuts;
+    # later rounds weight by hessian -> different cuts -> different trees
+    t_a = b_approx._gbm.model.trees[-1]
+    t_h = b_hist._gbm.model.trees[-1]
+    assert (t_a.num_nodes != t_h.num_nodes
+            or not np.allclose(t_a.split_conditions, t_h.split_conditions))

@@ -317,6 +317,19 @@ class GBTree:
             or "refresh" in getattr(self, "_updater_seq", [])
         )
 
+    @property
+    def needs_iteration_sketch(self) -> bool:
+        """tree_method='approx': the reference's histmaker re-proposes the
+        candidate cuts EVERY iteration from hessian-weighted sketches
+        (``src/tree/updater_histmaker.cc:639`` SerializeReducer AllReduce of
+        per-iteration WXQSketches); hist/tpu_hist sketch once. The learner
+        rebuilds the quantized matrix per round with hessian weights when
+        this is set."""
+        return (
+            self.gbtree_param.tree_method == "approx"
+            or "grow_histmaker" in getattr(self, "_updater_seq", [])
+        )
+
     def _grow_params(self, axis_name: Optional[str] = None) -> GrowParams:
         tp = self.train_param
         return GrowParams(

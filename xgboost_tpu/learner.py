@@ -207,7 +207,22 @@ class Booster:
                 entry.margin = None  # leaf values changed
                 return
             with self.monitor.section("GetBinned"):
-                binned = dtrain.get_binned(self._gbm.train_param.max_bin, dtrain.info.weight)
+                if getattr(self._gbm, "needs_iteration_sketch", False):
+                    # approx: fresh hessian-weighted cuts every round
+                    # (updater_histmaker.cc per-iteration proposal)
+                    from .data.quantile import BinnedMatrix
+
+                    hw = np.asarray(hess, np.float32)
+                    if hw.ndim == 2:
+                        hw = hw.sum(axis=1)
+                    if dtrain.info.weight is not None and len(dtrain.info.weight):
+                        hw = hw * np.asarray(dtrain.info.weight, np.float32)
+                    binned = BinnedMatrix.from_dense(
+                        dtrain.data, max_bin=self._gbm.train_param.max_bin,
+                        weights=hw,
+                    )
+                else:
+                    binned = dtrain.get_binned(self._gbm.train_param.max_bin, dtrain.info.weight)
             fw = dtrain.info.feature_weights
             with self.monitor.section("BoostOneRound"):
                 _, new_margin = self._gbm.boost_one_round(
