@@ -190,6 +190,18 @@ class DMatrix:
         ``GetBatches<GHistIndexMatrix>(BatchParam{max_bin})``)."""
         bm = self._binned.get(max_bin)
         if bm is None:
+            bm = self.build_binned(max_bin, sketch_weights)
+            self._binned[max_bin] = bm
+        return bm
+
+    def build_binned(
+        self, max_bin: int = 256, sketch_weights: Optional[np.ndarray] = None
+    ) -> BinnedMatrix:
+        """UNCACHED quantized-matrix build — same categorical and
+        distributed-sketch handling as ``get_binned``; used by the approx
+        per-iteration re-sketch (updater_histmaker.cc) with fresh hessian
+        weights every round."""
+        if True:
             cat = self.categorical_features()
             if cat:
                 self._validate_categorical(cat, max_bin)
@@ -230,7 +242,6 @@ class DMatrix:
                 self.data, max_bin=max_bin, weights=sketch_weights,
                 categorical=cat, cuts=cuts,
             )
-            self._binned[max_bin] = bm
         return bm
 
     def _validate_categorical(self, cat: List[int], max_bin: int) -> None:

@@ -235,6 +235,12 @@ class ExternalMemoryQuantileDMatrix(DMatrix):
             )
         return self._paged
 
+    def build_binned(self, max_bin: int = 256, sketch_weights=None):
+        raise NotImplementedError(
+            "per-iteration re-sketching (tree_method='approx') needs "
+            "in-memory data; external-memory matrices train with tpu_hist"
+        )
+
     def num_row(self) -> int:
         return self._paged.n_rows
 

@@ -50,6 +50,171 @@ class _PendingTree:
         self.max_depth = max_depth
 
 
+def _pad_stack(arrs, n_cols: int, col_pad: int, row_pad: int, fill, dtype):
+    """Stack 1-D per-tree arrays into a [row_pad, col_pad] device matrix:
+    per-array pad to ``n_cols`` then to pow2 ``col_pad`` columns and
+    ``row_pad`` rows (compile-reuse bucketing). Single home for the padding
+    policy used by every device stacker/materializer in this module."""
+    arrs = [a if a.shape[0] == n_cols
+            else jnp.pad(a, (0, n_cols - a.shape[0]), constant_values=fill)
+            for a in arrs]
+    s = jnp.stack(arrs)
+    if n_cols != col_pad:
+        s = jnp.pad(s, ((0, 0), (0, col_pad - n_cols)), constant_values=fill)
+    if s.shape[0] != row_pad:
+        s = jnp.pad(s, ((0, row_pad - s.shape[0]), (0, 0)),
+                    constant_values=fill)
+    return s.astype(dtype)
+
+
+class _PendingAllocTree:
+    """A lossguide tree still on device (allocation-ordered arrays +
+    on-device prune/leaf results). RegTree materialization via
+    ``RegTree.from_alloc`` is deferred like ``_PendingTree``."""
+
+    __slots__ = ("left", "right", "feature", "split_bin", "split_cond",
+                 "default_left", "node_weight", "loss_chg", "node_h",
+                 "cat_set", "keep", "leaf_value", "n_nodes", "depth",
+                 "eta", "gamma", "max_depth", "cat_mask")
+
+    def __init__(self, alloc, keep, leaf_value, eta, gamma, max_depth,
+                 cat_mask):
+        self.left = alloc.left
+        self.right = alloc.right
+        self.feature = alloc.feature
+        self.split_bin = alloc.split_bin
+        self.split_cond = alloc.split_cond
+        self.default_left = alloc.default_left
+        self.node_weight = alloc.node_weight
+        self.loss_chg = alloc.loss_chg
+        self.node_h = alloc.node_h
+        self.cat_set = alloc.cat_set
+        self.n_nodes = alloc.n_nodes
+        self.depth = alloc.depth
+        self.keep = keep
+        self.leaf_value = leaf_value
+        self.eta = eta
+        self.gamma = gamma
+        self.max_depth = max_depth
+        self.cat_mask = cat_mask
+
+
+def _materialize_pending_alloc(pending: List[_PendingAllocTree]) -> List[RegTree]:
+    """Bulk host conversion of device lossguide trees (pad to common width,
+    stack per field, one transfer per field)."""
+    if not pending:
+        return []
+    fields = ("left", "right", "feature", "split_cond", "default_left",
+              "node_weight", "loss_chg", "node_h", "split_bin", "n_nodes")
+    sizes = [t.left.shape[0] for t in pending]
+    Mmax = max(sizes)
+
+    def stack(f):
+        arrs = [getattr(t, f) for t in pending]
+        if f == "n_nodes":
+            return np.asarray(jnp.stack(arrs))
+        arrs = [a if a.shape[0] == Mmax
+                else jnp.pad(a, (0, Mmax - a.shape[0]),
+                             constant_values=(-1 if f in ("left", "right")
+                                              else 0))
+                for a in arrs]
+        return np.asarray(jnp.stack(arrs))
+
+    st = {f: stack(f) for f in fields}
+    cat_sets = None
+    if any(t.cat_mask is not None for t in pending):
+        cat_sets = [np.asarray(t.cat_set) for t in pending]
+    out = []
+    for i, t in enumerate(pending):
+        m = sizes[i]
+        tree, _ = RegTree.from_alloc(
+            st["left"][i][:m], st["right"][i][:m], st["feature"][i][:m],
+            st["split_cond"][i][:m], st["default_left"][i][:m],
+            st["node_weight"][i][:m], st["loss_chg"][i][:m],
+            st["node_h"][i][:m], int(st["n_nodes"][i]), eta=t.eta,
+            min_split_loss=t.gamma, split_bin=st["split_bin"][i][:m],
+            cat_features=t.cat_mask,
+            cat_set=cat_sets[i] if cat_sets is not None else None,
+        )
+        out.append(tree)
+    return out
+
+
+def _pack_cat_bits(cat_set: jax.Array) -> jax.Array:
+    """[T, M, B] bool right-going sets -> [T, M, W] uint32 bitfields
+    (common/bitfield.h CatBitField layout), W pow2-padded."""
+    T, M, B = cat_set.shape
+    W = max(1, -(-B // 32))
+    W = 1 << (W - 1).bit_length()
+    if B != W * 32:
+        cat_set = jnp.pad(cat_set, ((0, 0), (0, 0), (0, W * 32 - B)))
+    bits = cat_set.reshape(T, M, W, 32).astype(jnp.uint32)
+    weights = (jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32))
+    return (bits * weights).sum(axis=-1, dtype=jnp.uint32)
+
+
+def _stack_device_alloc(pending: List[_PendingAllocTree], tree_info,
+                        n_groups: int) -> StackedForest:
+    """Stacked forest from device lossguide trees — explicit child arrays
+    (allocation order), pruned topology applied via ``keep``. Uses the
+    XLA walk (not the heap pallas kernel). One scalar readback for the
+    walk depth bound."""
+    T = len(pending)
+    Tp = 1 << (T - 1).bit_length() if T > 1 else 1
+    M = max(t.left.shape[0] for t in pending)
+    Mp = max(1, 1 << (M - 1).bit_length())
+
+    def stack(get, fill, dtype):
+        return _pad_stack([get(t) for t in pending], M, Mp, Tp, fill, dtype)
+
+    keep = stack(lambda t: t.keep, False, bool)
+    left = jnp.where(keep, stack(lambda t: t.left, -1, jnp.int32), -1)
+    right = jnp.where(keep, stack(lambda t: t.right, -1, jnp.int32), -1)
+    cond = jnp.where(keep,
+                     stack(lambda t: t.split_cond, 0.0, jnp.float32),
+                     stack(lambda t: t.leaf_value, 0.0, jnp.float32))
+    feature = stack(lambda t: t.feature, 0, jnp.int32)
+    has_cats = any(t.cat_mask is not None for t in pending)
+    if has_cats:
+        catf = [jnp.asarray(t.cat_mask) if t.cat_mask is not None
+                else jnp.zeros(int(t.feature.max()) + 1, bool)
+                for t in pending]
+        st_rows = [cf[jnp.clip(t.feature, 0, cf.shape[0] - 1)]
+                   for cf, t in zip(catf, pending)]
+        split_type = jnp.stack(
+            [r if r.shape[0] == M else jnp.pad(r, (0, M - r.shape[0]))
+             for r in st_rows]
+        )
+        if M != Mp:
+            split_type = jnp.pad(split_type, ((0, 0), (0, Mp - M)))
+        if Tp != T:
+            split_type = jnp.pad(split_type, ((0, Tp - T), (0, 0)))
+        split_type = split_type & keep
+        css = [t.cat_set for t in pending]
+        B = max(c.shape[1] for c in css)
+        css = [jnp.pad(c, ((0, M - c.shape[0]), (0, B - c.shape[1])))
+               for c in css]
+        cat_all = jnp.stack(css)
+        if M != Mp:
+            cat_all = jnp.pad(cat_all, ((0, 0), (0, Mp - M), (0, 0)))
+        if Tp != T:
+            cat_all = jnp.pad(cat_all, ((0, Tp - T), (0, 0), (0, 0)))
+        cat_bits = _pack_cat_bits(cat_all)
+    else:
+        split_type = jnp.zeros((Tp, Mp), bool)
+        cat_bits = jnp.zeros((Tp, Mp, 1), jnp.uint32)
+    md = int(jnp.max(jnp.stack([jnp.max(t.depth) for t in pending]))) + 1
+    group = np.zeros(Tp, np.int32)
+    group[:T] = np.asarray(tree_info, np.int32)
+    return StackedForest(
+        left=left, right=right, feature=feature, cond=cond,
+        default_left=stack(lambda t: t.default_left, False, bool),
+        split_type=split_type, cat_bits=cat_bits,
+        tree_group=jnp.asarray(group), max_depth=max(md, 1),
+        n_groups=n_groups, has_cats=has_cats, heap_layout=False,
+    )
+
+
 def _materialize_pending(pending: List[_PendingTree]) -> List[RegTree]:
     """Convert device trees to host RegTrees in a handful of bulk transfers
     (one stacked array per field) instead of per-tree round trips."""
@@ -96,16 +261,7 @@ def _stack_device(pending: List[_PendingTree], tree_info: List[int],
     md = max(t.max_depth for t in pending)
 
     def stack(get, fill, dtype):
-        arrs = [get(t) for t in pending]
-        arrs = [a if a.shape[0] == N
-                else jnp.pad(a, (0, N - a.shape[0]), constant_values=fill)
-                for a in arrs]
-        s = jnp.stack(arrs)
-        if N != Np:
-            s = jnp.pad(s, ((0, 0), (0, Np - N)), constant_values=fill)
-        if Tp != T:
-            s = jnp.pad(s, ((0, Tp - T), (0, 0)), constant_values=fill)
-        return s.astype(dtype)
+        return _pad_stack([get(t) for t in pending], N, Np, Tp, fill, dtype)
 
     keep = stack(lambda t: t.keep, False, bool)
     iota = jnp.arange(Np, dtype=jnp.int32)[None, :]
@@ -157,17 +313,35 @@ class GBTreeModel:
         self.tree_info.append(group)
         self._stacked = None
 
+    def add_device_alloc(self, alloc, keep, leaf_value, eta: float,
+                         gamma: float, group: int, max_depth: int,
+                         cat_mask) -> None:
+        self._entries.append(_PendingAllocTree(
+            alloc, keep, leaf_value, eta, gamma, max_depth, cat_mask
+        ))
+        self.tree_info.append(group)
+        self._stacked = None
+
     @property
     def trees(self) -> List[RegTree]:
-        pending_ix = [i for i, e in enumerate(self._entries)
-                      if isinstance(e, _PendingTree)]
-        if pending_ix:
+        heap_ix = [i for i, e in enumerate(self._entries)
+                   if isinstance(e, _PendingTree)]
+        alloc_ix = [i for i, e in enumerate(self._entries)
+                    if isinstance(e, _PendingAllocTree)]
+        if heap_ix:
             converted = _materialize_pending(
-                [self._entries[i] for i in pending_ix]
+                [self._entries[i] for i in heap_ix]
             )
-            for i, t in zip(pending_ix, converted):
+            for i, t in zip(heap_ix, converted):
                 self._entries[i] = t
-            # a device-stacked forest uses raw heap node ids; after
+        if alloc_ix:
+            converted = _materialize_pending_alloc(
+                [self._entries[i] for i in alloc_ix]
+            )
+            for i, t in zip(alloc_ix, converted):
+                self._entries[i] = t
+        if heap_ix or alloc_ix:
+            # a device-stacked forest uses raw device node ids; after
             # materialization node ids are BFS-compacted — rebuild so
             # pred_leaf etc. are consistent with the saved model
             self._stacked = None
@@ -180,24 +354,21 @@ class GBTreeModel:
     def stacked(self) -> StackedForest:
         if self._stacked is not None and self._stacked_count == len(self._entries):
             return self._stacked
-        if self._entries and all(
-            isinstance(e, _PendingTree) for e in self._entries
-        ):
-            self._stacked = _stack_device(self._entries, self.tree_info,
-                                          self.n_groups)
-        else:
-            self._stacked = stack_forest(self.trees, self.tree_info,
-                                         self.n_groups)
+        self._stacked = self.stacked_slice(0, len(self._entries))
         self._stacked_count = len(self._entries)
         return self._stacked
 
     def stacked_slice(self, lo: int, hi: int) -> StackedForest:
         """Stacked forest over trees [lo, hi) WITHOUT materializing pending
-        device trees — the incremental prediction-cache catch-up must not
-        trigger host syncs mid-training (reference fast path gbtree.cc:519)."""
+        device trees when the slice is uniformly device-resident — neither
+        the incremental prediction-cache catch-up nor per-round DART
+        repredicts may trigger host syncs mid-training (gbtree.cc:519)."""
         ents = self._entries[lo:hi]
         if ents and all(isinstance(e, _PendingTree) for e in ents):
             return _stack_device(ents, self.tree_info[lo:hi], self.n_groups)
+        if ents and all(isinstance(e, _PendingAllocTree) for e in ents):
+            return _stack_device_alloc(ents, self.tree_info[lo:hi],
+                                       self.n_groups)
         trees = self.trees[lo:hi]
         return stack_forest(trees, self.tree_info[lo:hi], self.n_groups)
 
@@ -453,7 +624,10 @@ class GBTree:
                     else None
                 )
                 if lossguide:
-                    from ..tree.grow_lossguide import grow_tree_lossguide
+                    from ..tree.grow_lossguide import (
+                        finalize_alloc,
+                        grow_tree_lossguide,
+                    )
 
                     if use_mesh:
                         alloc = distributed_grow_tree_lossguide(
@@ -463,18 +637,25 @@ class GBTree:
                         alloc = grow_tree_lossguide(
                             binned.bins, g, h, cut_vals, key, cfg, max_leaves, fw
                         )
-                    tree, lmap_np = RegTree.from_alloc(
-                        np.asarray(alloc.left), np.asarray(alloc.right),
-                        np.asarray(alloc.feature), np.asarray(alloc.split_cond),
-                        np.asarray(alloc.default_left), np.asarray(alloc.node_weight),
-                        np.asarray(alloc.loss_chg), np.asarray(alloc.node_h),
-                        int(alloc.n_nodes), eta=tp.eta, min_split_loss=tp.gamma,
-                        split_bin=np.asarray(alloc.split_bin), cat_features=cat_mask,
-                        cat_set=(
-                            np.asarray(alloc.cat_set) if cfg.has_categorical else None
-                        ),
+                    # on-device prune/leaf-values/delta: the lossguide round
+                    # performs zero host syncs, like the fused depthwise path
+                    keep, lv, delta_full = finalize_alloc(
+                        alloc, jnp.float32(tp.eta), jnp.float32(tp.gamma)
                     )
-                    positions = alloc.positions
+                    self.model.add_device_alloc(
+                        alloc, keep, lv, tp.eta, tp.gamma, k, tp.max_depth,
+                        cat_mask,
+                    )
+                    new_trees.append(alloc)
+                    if margin_cache is not None:
+                        delta = delta_full
+                        if use_mesh and delta.shape[0] != binned.n_rows:
+                            delta = delta[: binned.n_rows]
+                        if margin_cache.ndim == 2:
+                            margin_cache = margin_cache.at[:, k].add(delta)
+                        else:
+                            margin_cache = margin_cache + delta
+                    continue
                 else:
                     if use_mesh:
                         heap = distributed_grow_tree(

@@ -209,17 +209,22 @@ class Booster:
             with self.monitor.section("GetBinned"):
                 if getattr(self._gbm, "needs_iteration_sketch", False):
                     # approx: fresh hessian-weighted cuts every round
-                    # (updater_histmaker.cc per-iteration proposal)
-                    from .data.quantile import BinnedMatrix
-
+                    # (updater_histmaker.cc per-iteration proposal). hess is
+                    # already instance-weight-scaled by the objective, so it
+                    # is the complete sketch weight. Reuses the cached
+                    # get_binned path's categorical + distributed-sketch
+                    # machinery via the uncached builder.
+                    if not hasattr(dtrain, "build_binned"):
+                        raise NotImplementedError(
+                            "tree_method='approx' needs in-memory data for "
+                            "per-iteration re-sketching; use tpu_hist for "
+                            "external-memory matrices"
+                        )
                     hw = np.asarray(hess, np.float32)
                     if hw.ndim == 2:
                         hw = hw.sum(axis=1)
-                    if dtrain.info.weight is not None and len(dtrain.info.weight):
-                        hw = hw * np.asarray(dtrain.info.weight, np.float32)
-                    binned = BinnedMatrix.from_dense(
-                        dtrain.data, max_bin=self._gbm.train_param.max_bin,
-                        weights=hw,
+                    binned = dtrain.build_binned(
+                        self._gbm.train_param.max_bin, hw
                     )
                 else:
                     binned = dtrain.get_binned(self._gbm.train_param.max_bin, dtrain.info.weight)

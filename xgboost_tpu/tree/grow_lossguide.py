@@ -67,6 +67,62 @@ class AllocTree(NamedTuple):
     # [M, B] right-going category set per categorical split node
     # ([1, 1] placeholder when no categorical features)
     cat_set: jax.Array
+    depth: jax.Array  # int32 [M] node depths (walk bound for the predictor)
+
+
+@jax.jit
+def finalize_alloc(alloc: AllocTree, eta, gamma):
+    """On-device gamma pruning + governing leaf values + cache delta for an
+    allocation-ordered tree — the device analog of ``RegTree.from_alloc``'s
+    host passes, so a lossguide round performs no device->host syncs.
+    Children always have larger ids, so ONE descending pass is the pruning
+    fixpoint and ONE ascending pass propagates pruned-leaf values down.
+    Returns (keep [M], leaf_value [M] (eta-applied, 0 at kept-internal),
+    delta [n])."""
+    left, right, loss = alloc.left, alloc.right, alloc.loss_chg
+    M = left.shape[0]
+    iota = jnp.arange(M)
+    in_range = iota < alloc.n_nodes
+    keep0 = (left != -1) & in_range
+
+    def pbody(t, keep):
+        i = M - 1 - t
+        l = jnp.clip(left[i], 0, M - 1)
+        r = jnp.clip(right[i], 0, M - 1)
+        lk = jnp.where(left[i] >= 0, keep[l], False)
+        rk = jnp.where(right[i] >= 0, keep[r], False)
+        collapse = keep[i] & ~lk & ~rk & (loss[i] < gamma)
+        return keep.at[i].set(keep[i] & ~collapse)
+
+    keep = jax.lax.cond(
+        gamma > 0.0,
+        lambda k: jax.lax.fori_loop(0, M, pbody, k),
+        lambda k: k,
+        keep0,
+    )
+
+    nan = jnp.float32(jnp.nan)
+    lv0 = jnp.full((M,), nan)
+
+    def vbody(i, lv):
+        own = jnp.isnan(lv[i]) & ~keep[i] & (i < alloc.n_nodes)
+        lv = lv.at[i].set(jnp.where(own, eta * alloc.node_weight[i], lv[i]))
+        li = jnp.clip(left[i], 0, M - 1)
+        ri = jnp.clip(right[i], 0, M - 1)
+        prop = (left[i] != -1) & ~jnp.isnan(lv[i])
+        lv = lv.at[li].set(jnp.where(prop, lv[i], lv[li]))
+        lv = lv.at[ri].set(jnp.where(prop, lv[i], lv[ri]))
+        return lv
+
+    lv = jax.lax.fori_loop(0, M, vbody, lv0)
+    lv = jnp.nan_to_num(lv)
+
+    from .hist_kernel import leaf_delta, use_pallas
+
+    pad = max(128, 1 << (M - 1).bit_length())
+    delta = leaf_delta(alloc.positions[:, None], lv, pad,
+                       pallas=use_pallas())
+    return keep, lv, delta
 
 
 @partial(jax.jit, static_argnames=("cfg", "max_leaves"))
@@ -360,4 +416,5 @@ def grow_tree_lossguide(
         split_cond=split_cond, default_left=default_left,
         node_g=node_g, node_h=node_h, node_weight=node_w,
         loss_chg=loss_chg, n_nodes=n_alloc, positions=pos, cat_set=cat_set,
+        depth=depth,
     )
