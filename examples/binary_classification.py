@@ -1,0 +1,13 @@
+"""Train/eval on the agaricus mushroom data (the reference's canonical
+demo: demo/CLI + guide-python basic_walkthrough)."""
+import xgboost_tpu as xgb
+
+dtrain = xgb.DMatrix("/root/reference/demo/data/agaricus.txt.train")
+dtest = xgb.DMatrix("/root/reference/demo/data/agaricus.txt.test")
+bst = xgb.train(
+    {"objective": "binary:logistic", "max_depth": 2, "eta": 1.0,
+     "eval_metric": ["error", "auc"]},
+    dtrain, 10, evals=[(dtest, "eval")],
+)
+bst.save_model("/tmp/agaricus.json")
+print("saved /tmp/agaricus.json; trees:", bst.num_boosted_rounds())

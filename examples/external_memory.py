@@ -1,0 +1,33 @@
+"""Out-of-core training from a batch iterator with a disk page cache
+(demo/guide-python/external_memory.py analog)."""
+import numpy as np
+import xgboost_tpu as xgb
+from xgboost_tpu.data.iterator import DataIter
+
+rng = np.random.RandomState(0)
+BATCHES = [rng.randn(5000, 10).astype(np.float32) for _ in range(4)]
+LABELS = [(b.sum(1) > 0).astype(np.float32) for b in BATCHES]
+
+
+class Iter(DataIter):
+    def __init__(self):
+        super().__init__()
+        self.i = 0
+
+    def reset(self):
+        self.i = 0
+
+    def next(self, input_data):
+        if self.i >= len(BATCHES):
+            return 0
+        input_data(data=BATCHES[self.i], label=LABELS[self.i])
+        self.i += 1
+        return 1
+
+
+d = xgb.ExternalMemoryQuantileDMatrix(Iter(), max_bin=128, page_rows=4096)
+bst = xgb.train({"objective": "binary:logistic", "max_depth": 5,
+                 "max_bin": 128}, d, 10,
+                verbose_eval=False)
+print("rows:", d.num_row(), "pages:", d.get_binned(128).n_pages,
+      "rounds:", bst.num_boosted_rounds())

@@ -1,0 +1,18 @@
+"""LambdaMART learning-to-rank (demo/rank analog)."""
+import numpy as np
+import xgboost_tpu as xgb
+from xgboost_tpu.metric import create_metric
+
+rng = np.random.RandomState(0)
+G, S = 80, 20
+sizes = np.full(G, S)
+X = rng.randn(G * S, 8).astype(np.float32)
+rel = X @ rng.randn(8) + 0.5 * rng.randn(G * S)
+y = np.digitize(rel, np.quantile(rel, [0.6, 0.85, 0.97])).astype(np.float32)
+d = xgb.DMatrix(X, label=y)
+d.set_group(sizes)
+bst = xgb.train({"objective": "rank:ndcg", "eta": 0.3, "max_depth": 4},
+                d, 15, verbose_eval=False)
+gptr = np.concatenate([[0], np.cumsum(sizes)])
+ndcg = create_metric("ndcg@10")
+print("ndcg@10:", float(ndcg.evaluate(bst.predict(d), y, group_ptr=gptr)))

@@ -1,0 +1,14 @@
+"""sklearn estimator facade (demo/guide-python/sklearn_examples.py analog)."""
+import numpy as np
+from xgboost_tpu.sklearn import XGBClassifier, XGBRegressor
+
+rng = np.random.RandomState(0)
+X = rng.randn(2000, 10).astype(np.float32)
+y = (X.sum(1) > 0).astype(int)
+clf = XGBClassifier(n_estimators=10, max_depth=4, learning_rate=0.3)
+clf.fit(X[:1500], y[:1500], eval_set=[(X[1500:], y[1500:])], verbose=False)
+print("accuracy:", clf.score(X[1500:], y[1500:]))
+
+yr = X @ rng.randn(10)
+reg = XGBRegressor(n_estimators=10).fit(X, yr)
+print("r2-ish corr:", np.corrcoef(reg.predict(X), yr)[0, 1])

@@ -1,0 +1,33 @@
+"""Smoke-run the examples/ suite (reference: tests/python/test_demos.py
+executes demo/ scripts the same way)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_EX = os.path.join(os.path.dirname(__file__), "..", "examples")
+
+
+@pytest.mark.parametrize("script", [
+    "binary_classification.py",
+    "sklearn_interface.py",
+    "ranking.py",
+    "survival_aft.py",
+    "distributed_mesh.py",
+    "external_memory.py",
+])
+def test_example_runs(script):
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    root = os.path.abspath(os.path.join(_EX, ".."))
+    env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run(
+        [sys.executable, os.path.join(_EX, script)],
+        capture_output=True, text=True, timeout=600, env=env,
+        cwd=os.path.join(_EX, ".."),
+    )
+    assert r.returncode == 0, r.stderr[-2000:]

@@ -1,0 +1,99 @@
+"""Property-based updater tests (reference strategy:
+tests/python/test_updaters.py drives hist/approx/exact through hypothesis
+hyper-parameter strategies and asserts structural invariants). Same idea
+for tpu_hist: random hyper-parameters -> train -> invariants hold."""
+
+import numpy as np
+import pytest
+from hypothesis import HealthCheck, given, settings
+from hypothesis import strategies as st
+
+import xgboost_tpu as xgb
+
+_N, _F = 1500, 6
+_rng = np.random.RandomState(7)
+_X = _rng.randn(_N, _F).astype(np.float32)
+_X[_rng.rand(_N, _F) < 0.08] = np.nan
+_W = _rng.randn(_F)
+_Y = (np.nan_to_num(_X) @ _W + 0.5 * _rng.randn(_N) > 0).astype(np.float32)
+
+hyper = st.fixed_dictionaries({
+    "max_depth": st.integers(1, 6),
+    "max_bin": st.sampled_from([8, 32, 128, 256]),
+    "eta": st.floats(0.05, 1.0),
+    "gamma": st.floats(0.0, 2.0),
+    "reg_lambda": st.floats(0.0, 4.0),
+    "reg_alpha": st.floats(0.0, 1.0),
+    "min_child_weight": st.floats(0.0, 8.0),
+    "subsample": st.floats(0.4, 1.0),
+    "colsample_bytree": st.floats(0.4, 1.0),
+    "colsample_bylevel": st.floats(0.4, 1.0),
+    "grow_policy": st.sampled_from(["depthwise", "lossguide"]),
+    "sampling_method": st.sampled_from(["uniform", "gradient_based"]),
+})
+
+
+def _tree_wellformed(t, max_depth):
+    n = t.num_nodes
+    assert (t.left_children < n).all() and (t.right_children < n).all()
+    internal = t.left_children != -1
+    assert (t.right_children[internal] != -1).all()
+    assert (t.left_children[~internal] == -1).all()
+    # parents consistent
+    for i in range(1, n):
+        p = t.parents[i]
+        assert i in (t.left_children[p], t.right_children[p])
+    if max_depth > 0:
+        assert t.max_depth() <= max_depth
+    assert np.isfinite(t.split_conditions).all()
+    assert (t.sum_hessian >= 0).all()
+
+
+@settings(max_examples=12, deadline=None,
+          suppress_health_check=[HealthCheck.too_slow])
+@given(hyper)
+def test_random_hyperparameters_produce_wellformed_learners(params):
+    d = xgb.DMatrix(_X, label=_Y)
+    bst = xgb.train({"objective": "binary:logistic", **params}, d, 4,
+                    verbose_eval=False)
+    pred = bst.predict(d)
+    assert np.isfinite(pred).all()
+    assert (pred >= 0).all() and (pred <= 1).all()
+    for t in bst._gbm.model.trees:
+        _tree_wellformed(t, params["max_depth"])
+    # serialization survives arbitrary hyper-parameters
+    blob = bst.save_raw()
+    b2 = xgb.Booster(model_file=blob)
+    np.testing.assert_allclose(b2.predict(d), pred, rtol=1e-5, atol=1e-6)
+
+
+@settings(max_examples=6, deadline=None,
+          suppress_health_check=[HealthCheck.too_slow])
+@given(st.sampled_from([(1, -1), (-1, 1), (1, 1), (-1, -1)]))
+def test_monotone_constraints_hold_under_random_direction(signs):
+    rng = np.random.RandomState(3)
+    X = rng.rand(1200, 2).astype(np.float32)
+    y = (X[:, 0] - X[:, 1] + 0.2 * rng.randn(1200)).astype(np.float32)
+    d = xgb.DMatrix(X, label=y)
+    bst = xgb.train({"objective": "reg:squarederror", "max_depth": 4,
+                     "monotone_constraints": f"({signs[0]},{signs[1]})"},
+                    d, 6, verbose_eval=False)
+    base = np.full((50, 2), 0.5, np.float32)
+    for f, sign in enumerate(signs):
+        grid = base.copy()
+        grid[:, f] = np.linspace(0.01, 0.99, 50)
+        p = bst.predict(xgb.DMatrix(grid))
+        diffs = np.diff(p) * sign
+        assert (diffs >= -1e-5).all(), (f, sign)
+
+
+@settings(max_examples=6, deadline=None,
+          suppress_health_check=[HealthCheck.too_slow])
+@given(st.integers(2, 32))
+def test_max_leaves_budget_respected(max_leaves):
+    d = xgb.DMatrix(_X, label=_Y)
+    bst = xgb.train({"objective": "binary:logistic",
+                     "grow_policy": "lossguide", "max_depth": 0,
+                     "max_leaves": max_leaves}, d, 2, verbose_eval=False)
+    for t in bst._gbm.model.trees:
+        assert t.num_leaves <= max_leaves

@@ -120,6 +120,30 @@ class DMatrix:
         self._binned: Dict[int, BinnedMatrix] = {}
 
     # ---- metadata setters (reference: MetaInfo::SetInfo, data.cc) ----
+    #: float fields settable through the reference's set_float_info API
+    _FLOAT_INFO = ("label", "weight", "base_margin", "label_lower_bound",
+                   "label_upper_bound", "feature_weights")
+
+    def set_float_info(self, field: str, data: Any) -> None:
+        """Reference core.py DMatrix.set_float_info parity."""
+        if field not in self._FLOAT_INFO:
+            raise ValueError(f"unknown float field: {field!r}")
+        setattr(self.info, field, np.asarray(data, dtype=np.float32))
+
+    def get_float_info(self, field: str) -> np.ndarray:
+        if field not in self._FLOAT_INFO:
+            raise ValueError(f"unknown float field: {field!r}")
+        v = getattr(self.info, field)
+        return np.asarray(v, np.float32) if v is not None else np.array([], np.float32)
+
+    def set_uint_info(self, field: str, data: Any) -> None:
+        if field == "group_ptr":
+            self.info.group_ptr = np.asarray(data, np.int64)
+        elif field == "group":
+            self.set_group(data)
+        else:
+            raise ValueError(f"unknown uint field: {field!r}")
+
     def set_label(self, label: Any) -> None:
         self.info.label = np.asarray(label, dtype=np.float32).reshape(-1)
 
