@@ -23,3 +23,14 @@ try:
     jax.config.update("jax_platforms", "cpu")
 except Exception:  # backends already initialized; tests will use what exists
     pass
+
+# persistent compilation cache: repeat suite runs skip recompiles (the
+# 8-virtual-device shard_map programs are the expensive ones)
+try:
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.environ.get("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache_tests"),
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+except Exception:
+    pass

@@ -404,3 +404,143 @@ def test_approx_resketeches_per_iteration():
     t_h = b_hist._gbm.model.trees[-1]
     assert (t_a.num_nodes != t_h.num_nodes
             or not np.allclose(t_a.split_conditions, t_h.split_conditions))
+
+
+def test_fault_injection_mock_recovery(tmp_path):
+    """The rabit allreduce_mock analog (rabit/src/allreduce_mock.h: kill a
+    worker at a scripted (version, seqno) ntrial times; recovery = restart
+    from the last checkpoint). Scripts a fault at round 6 that fires twice;
+    a restart loop resuming from TrainingCheckPoint files must converge to
+    the exact uninterrupted model."""
+    from xgboost_tpu.utils.fault import InjectedFault, fault_injection
+
+    rng = np.random.RandomState(1)
+    X = rng.randn(2000, 6).astype(np.float32)
+    y = (np.nan_to_num(X).sum(1) > 0).astype(np.float32)
+    params = {"objective": "binary:logistic", "max_depth": 3, "eta": 0.3}
+    rounds = 10
+
+    d = xgb.DMatrix(X, label=y)
+    full = xgb.train(params, d, rounds, verbose_eval=False)
+
+    def latest_checkpoint():
+        cks = sorted(tmp_path.glob("ck_*.json"),
+                     key=lambda p: int(p.stem.split("_")[1]))
+        return cks[-1] if cks else None
+
+    # fault at version 6, seqno 1 (the "grow" site), two trials: the first
+    # restart hits it again before it exhausts — the mock's ntrial semantics
+    with fault_injection({(6, 1): 2}) as spec:
+        attempts = 0
+        bst = None
+        while attempts < 5:
+            attempts += 1
+            prev = latest_checkpoint()
+            model = None
+            done = 0
+            if prev is not None:
+                model = xgb.Booster(params)
+                model.load_model(str(prev))
+                done = model.num_boosted_rounds()
+            try:
+                bst = xgb.train(
+                    params, xgb.DMatrix(X, label=y), rounds - done,
+                    xgb_model=model, verbose_eval=False,
+                    callbacks=[xgb.callback.TrainingCheckPoint(
+                        str(tmp_path), name="ck", interval=2)],
+                )
+                break
+            except InjectedFault:
+                continue
+        assert bst is not None and attempts == 3  # 2 kills + 1 clean run
+        assert [f[0] for f in spec.fired] == ["grow", "grow"]
+
+    assert bst.num_boosted_rounds() == rounds
+    np.testing.assert_allclose(bst.predict(d), full.predict(d),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_fault_injection_inactive_is_noop():
+    from xgboost_tpu.utils import fault
+
+    fault.begin_version(3)  # no spec armed: must be a no-op
+    fault.inject("gradient")
+    with fault.fault_injection({(0, 0): 1}) as spec:
+        fault.begin_version(0)
+        try:
+            fault.inject("gradient")
+            raise AssertionError("fault did not fire")
+        except fault.InjectedFault as e:
+            assert (e.version, e.seqno, e.site) == (0, 0, "gradient")
+        # trigger exhausted: same site next round is clean
+        fault.begin_version(1)
+        fault.inject("gradient")
+        assert spec.fired == [("gradient", 0, 0)]
+
+
+def test_tree_method_exact_recovers_exact_threshold():
+    """tree_method='exact' = exact binning (one bin per distinct value, the
+    colmaker candidate set, updater_colmaker.cc:367): a split threshold
+    invisible to coarse quantile cuts must be found exactly."""
+    rng = np.random.RandomState(0)
+    # 997 distinct values; label flips at an arbitrary one of them
+    vals = np.sort(rng.randn(997).astype(np.float32))
+    x = vals[rng.randint(0, 997, size=4000)]
+    cut = vals[700]
+    y = (x >= cut).astype(np.float32)
+    d = xgb.DMatrix(x[:, None], label=y)
+    hist = xgb.train({"objective": "binary:logistic", "max_depth": 1,
+                      "max_bin": 8, "eta": 1.0}, d, 1, verbose_eval=False)
+    d2 = xgb.DMatrix(x[:, None], label=y)
+    exact = xgb.train({"objective": "binary:logistic", "max_depth": 1,
+                       "tree_method": "exact", "eta": 1.0}, d2, 1,
+                      verbose_eval=False)
+    # the exact tree's root condition IS the flip value; 8 quantile bins
+    # cannot represent it
+    t = exact._gbm.model.trees[0]
+    assert t.num_nodes == 3
+    assert np.isclose(t.split_conditions[0], cut)
+    err_exact = ((exact.predict(d2) > 0.5) != y).mean()
+    err_hist = ((hist.predict(d) > 0.5) != y).mean()
+    assert err_exact == 0.0
+    assert err_hist > 0.0
+    assert not np.isclose(hist._gbm.model.trees[0].split_conditions[0], cut)
+
+
+def test_tree_method_exact_cap_and_colmaker_alias():
+    from xgboost_tpu.data.quantile import compute_exact_cuts
+
+    rng = np.random.RandomState(1)
+    X = rng.randn(300, 2).astype(np.float32)  # ~300 distinct per feature
+    with pytest.raises(ValueError, match="distinct"):
+        compute_exact_cuts(X, cap=100)
+
+    y = (X[:, 0] > 0).astype(np.float32)
+    d = xgb.DMatrix(X, label=y)
+    bst = xgb.train({"objective": "binary:logistic", "max_depth": 2,
+                     "updater": "grow_colmaker"}, d, 2, verbose_eval=False)
+    # exact binning was used: the binned cache carries the "exact" key
+    assert "exact" in d._binned
+    assert np.isfinite(bst.predict(d)).all()
+
+
+def test_tree_method_exact_sparse_categorical_codes():
+    """Exact cuts must size the bin width from the max category code, not
+    the distinct-value count: sparse codes (e.g. {0, 100}) would otherwise
+    be rejected by the identity-cut validation."""
+    import pandas as pd
+
+    rng = np.random.RandomState(2)
+    codes = rng.choice([0, 100], size=500)
+    x2 = rng.randn(500).astype(np.float32)
+    df = pd.DataFrame({
+        "c": pd.Categorical.from_codes(
+            codes, categories=[str(i) for i in range(101)]),
+        "q": x2,
+    })
+    y = (codes == 100).astype(np.float32)
+    d = xgb.DMatrix(df, label=y, enable_categorical=True)
+    bst = xgb.train({"objective": "binary:logistic", "max_depth": 2,
+                     "tree_method": "exact", "eta": 1.0}, d, 1,
+                    verbose_eval=False)
+    assert ((bst.predict(d) > 0.5) == y.astype(bool)).all()

@@ -218,6 +218,33 @@ class DMatrix:
             self._binned[max_bin] = bm
         return bm
 
+    def get_binned_exact(self, cap: int = 16384) -> BinnedMatrix:
+        """Quantized view with cuts at EVERY distinct value — the exact
+        candidate set tree_method='exact' trains on (colmaker semantics,
+        ``src/tree/updater_colmaker.cc:367``; see
+        ``quantile.compute_exact_cuts``). Cached under its own key."""
+        bm = self._binned.get("exact")
+        if bm is None:
+            import jax
+
+            if jax.process_count() > 1:
+                raise NotImplementedError(
+                    "tree_method='exact' is single-process only (each "
+                    "process sees only its row shard, so globally exact "
+                    "cuts cannot be built); use tpu_hist"
+                )
+            from .quantile import compute_exact_cuts
+
+            cat = self.categorical_features()
+            cuts = compute_exact_cuts(self.data, cap=cap, categorical=cat)
+            if cat:
+                self._validate_categorical(cat, cuts.max_bin)
+            bm = BinnedMatrix.from_dense(
+                self.data, max_bin=cuts.max_bin, cuts=cuts, categorical=cat
+            )
+            self._binned["exact"] = bm
+        return bm
+
     def build_binned(
         self, max_bin: int = 256, sketch_weights: Optional[np.ndarray] = None
     ) -> BinnedMatrix:

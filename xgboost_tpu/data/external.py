@@ -241,6 +241,12 @@ class ExternalMemoryQuantileDMatrix(DMatrix):
             "in-memory data; external-memory matrices train with tpu_hist"
         )
 
+    def get_binned_exact(self, cap: int = 16384):
+        raise NotImplementedError(
+            "tree_method='exact' needs in-memory data; external-memory "
+            "matrices train with tpu_hist"
+        )
+
     def num_row(self) -> int:
         return self._paged.n_rows
 

@@ -32,8 +32,8 @@ import jax.numpy as jnp
 import numpy as np
 
 __all__ = [
-    "HistogramCuts", "compute_cuts", "bin_matrix", "BinnedMatrix",
-    "apply_categorical_identity",
+    "HistogramCuts", "compute_cuts", "compute_exact_cuts", "bin_matrix",
+    "BinnedMatrix", "apply_categorical_identity",
 ]
 
 
@@ -137,6 +137,63 @@ def compute_cuts(
     min_vals = np.array(min_vals)
     if categorical:
         apply_categorical_identity(values, min_vals, categorical)
+    return HistogramCuts(values=values, min_vals=min_vals)
+
+
+def compute_exact_cuts(
+    X: np.ndarray,
+    cap: int = 16384,
+    categorical: Optional[Sequence[int]] = None,
+) -> HistogramCuts:
+    """Cuts at EVERY distinct finite value per feature — the exact-greedy
+    candidate set. With these cuts the hist grower enumerates precisely the
+    splits ``grow_colmaker`` (reference ``src/tree/updater_colmaker.cc:367``:
+    sorted column scan over all value boundaries) enumerates, so
+    ``tree_method='exact'`` is realized as exact binning + the same
+    fixed-shape level program instead of a data-dependent column scan (which
+    cannot map to XLA). Split conditions are the boundary values themselves
+    rather than colmaker's midpoints — both classify every finite input
+    identically; the reference's own hist family makes the same choice.
+
+    ``cap`` bounds the bin width (the [F, B] cuts tensor and the level
+    histograms scale with B); truly continuous features exceed it and the
+    caller should use a quantile method instead — the reference likewise
+    steers large data away from exact (``gbtree.cc:133-155`` auto
+    selection).
+    """
+    Xn = np.asarray(X, np.float32)
+    cat_set = frozenset(categorical or ())
+    uniques = []
+    widest = 0
+    for f in range(Xn.shape[1]):
+        col = Xn[:, f]
+        u = np.unique(col[~np.isnan(col)])  # sorted, NaN dropped
+        if len(u) > cap:
+            raise ValueError(
+                f"tree_method='exact': feature {f} has {len(u)} distinct "
+                f"values (> cap {cap}); use tree_method='tpu_hist' for "
+                "high-cardinality continuous data"
+            )
+        if f in cat_set and len(u):
+            # identity cuts need B > max category code, even when codes are
+            # sparse (distinct count alone would undersize the width)
+            widest = max(widest, int(u[-1]) + 1)
+        else:
+            widest = max(widest, len(u))
+        uniques.append(u)
+    B = max(widest + 1, 2)
+    values = np.empty((Xn.shape[1], B), np.float32)
+    min_vals = np.zeros((Xn.shape[1],), np.float32)
+    for f, u in enumerate(uniques):
+        if len(u) == 0:
+            values[f] = np.arange(1, B + 1, dtype=np.float32)
+            continue
+        sentinel = u[-1] + max(1.0, abs(float(u[-1])))
+        values[f, : len(u)] = u
+        values[f, len(u):] = sentinel  # duplicate padding: empty bins
+        min_vals[f] = u[0]
+    if categorical:
+        apply_categorical_identity(values, min_vals, list(categorical))
     return HistogramCuts(values=values, min_vals=min_vals)
 
 

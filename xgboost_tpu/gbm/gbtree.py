@@ -415,14 +415,11 @@ class GBTree:
     def _configure_method(self) -> None:
         tm = self.gbtree_param.tree_method
         # every quantile-hist family method maps onto the tpu_hist grower;
-        # exact has no TPU-native analog (data-dependent column scans) — the
-        # reference's GPU path makes the same substitution
-        if tm == "exact":
-            console_logger.warning(
-                "tree_method='exact' is not TPU-native; using 'tpu_hist' "
-                "(same substitution the reference makes for gpu_hist)"
-            )
-        elif tm not in ("auto", "hist", "gpu_hist", "tpu_hist", "approx"):
+        # exact is realized as exact binning (cuts at every distinct value,
+        # compute_exact_cuts) + the same fixed-shape level program — the
+        # colmaker candidate set without its data-dependent column scans
+        if tm not in ("auto", "exact", "hist", "gpu_hist", "tpu_hist",
+                      "approx"):
             raise ValueError(f"Unknown tree_method: {tm}")
         # explicit updater sequence overrides tree_method (gbtree.cc:158):
         # grow_* -> the fused grower; refresh -> the refresh pass; unknown
@@ -486,6 +483,17 @@ class GBTree:
         return (
             self.gbtree_param.process_type == "update"
             or "refresh" in getattr(self, "_updater_seq", [])
+        )
+
+    @property
+    def needs_exact_cuts(self) -> bool:
+        """tree_method='exact' / updater='grow_colmaker': train on the
+        exact-greedy candidate set (one bin per distinct value,
+        ``compute_exact_cuts``) instead of quantile cuts — the TPU
+        realization of ``src/tree/updater_colmaker.cc``."""
+        return (
+            self.gbtree_param.tree_method == "exact"
+            or "grow_colmaker" in getattr(self, "_updater_seq", [])
         )
 
     @property

@@ -27,7 +27,7 @@ from .metric import create_metric
 from .objective import create_objective
 from .params import LearnerParam
 from .registry import BOOSTERS, OBJECTIVES
-from .utils import Monitor, console_logger
+from .utils import Monitor, console_logger, fault
 
 __all__ = ["Booster"]
 
@@ -157,6 +157,8 @@ class Booster:
     def update(self, dtrain: DMatrix, iteration: int, fobj=None) -> None:
         """One boosting iteration (reference UpdateOneIter learner.cc:1060)."""
         self._configure()
+        fault.begin_version(iteration)
+        fault.inject("gradient")
         if fobj is not None:
             margin = self._cached_margin(dtrain)
             pred = np.asarray(margin)
@@ -195,6 +197,7 @@ class Booster:
         self._do_boost(dtrain, grad, hess, iteration=self.num_boosted_rounds())
 
     def _do_boost(self, dtrain: DMatrix, grad, hess, iteration: int) -> None:
+        fault.inject("grow")
         entry = self._caches.setdefault(id(dtrain), _PredCache())
         if self._gbm.name in ("gbtree", "dart"):
             if getattr(self._gbm, "_is_update_process", False):
@@ -226,6 +229,15 @@ class Booster:
                     binned = dtrain.build_binned(
                         self._gbm.train_param.max_bin, hw
                     )
+                elif getattr(self._gbm, "needs_exact_cuts", False):
+                    # exact: one bin per distinct value (colmaker candidate
+                    # set, updater_colmaker.cc:367)
+                    if not hasattr(dtrain, "get_binned_exact"):
+                        raise NotImplementedError(
+                            "tree_method='exact' needs in-memory data; "
+                            "use tpu_hist for external-memory matrices"
+                        )
+                    binned = dtrain.get_binned_exact()
                 else:
                     binned = dtrain.get_binned(self._gbm.train_param.max_bin, dtrain.info.weight)
             fw = dtrain.info.feature_weights
@@ -257,6 +269,7 @@ class Booster:
 
     def eval_set(self, evals, iteration: int = 0, feval=None, output_margin: bool = True) -> str:
         self._configure()
+        fault.inject("eval")
         parts = [f"[{iteration}]"]
         for dmat, name in evals:
             margin = self._predict_margin(dmat)

@@ -1,2 +1,3 @@
+from . import fault  # noqa: F401
 from .log import Logger, console_logger  # noqa: F401
 from .timer import Monitor  # noqa: F401

@@ -1,0 +1,103 @@
+"""Scripted fault injection for recovery testing.
+
+Analog of rabit's mock engine (reference ``rabit/src/allreduce_mock.h:20-50``,
+built with ``RABIT_MOCK`` — ``CMakeLists.txt:47``): the mock kills a worker
+when a scripted ``(rank, version, seqno, ntrial)`` tuple matches the current
+collective call, and the fault-tolerance tests assert training recovers from
+the last checkpoint.
+
+Single-controller JAX has no per-worker process to kill — worker death is
+process death, and the recovery story (matching the reference's production
+behavior) is restart-from-checkpoint. The structural equivalents of the
+mock's interception points are the host-side dispatch boundaries of each
+round: ``version`` is the boosting round (rabit's model version), ``seqno``
+counts injection sites hit within the round (rabit's collective sequence
+number), and ``ntrial`` is how many times the fault fires before the
+trigger is exhausted (rabit kills a restarted worker again until ntrial
+runs out).
+
+Usage (see ``tests/test_components.py``)::
+
+    with fault_injection({(5, 1): 2}):          # version 5, seqno 1, twice
+        for attempt in range(max_restarts):
+            try:
+                bst = train(..., xgb_model=last_checkpoint)
+                break
+            except InjectedFault:
+                continue                         # restart from checkpoint
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Dict, Iterator, Tuple
+
+__all__ = ["InjectedFault", "fault_injection", "inject", "begin_version"]
+
+_state = threading.local()
+
+
+class InjectedFault(RuntimeError):
+    """The scripted fault: the moral equivalent of the mock engine's
+    ``exit(-2)`` at a matching (version, seqno) — except recoverable
+    in-process so tests can exercise the restart loop."""
+
+    def __init__(self, site: str, version: int, seqno: int, trial: int):
+        super().__init__(
+            f"injected fault at site={site!r} version={version} "
+            f"seqno={seqno} (trial {trial})"
+        )
+        self.site = site
+        self.version = version
+        self.seqno = seqno
+        self.trial = trial
+
+
+class _FaultSpec:
+    def __init__(self, triggers: Dict[Tuple[int, int], int]):
+        # {(version, seqno): remaining_trials}
+        self.triggers = dict(triggers)
+        self.version = -1
+        self.seqno = 0
+        self.fired = []  # [(site, version, seqno)] audit log
+
+
+@contextlib.contextmanager
+def fault_injection(triggers: Dict[Tuple[int, int], int]) -> Iterator[_FaultSpec]:
+    """Arm scripted faults: ``{(version, seqno): ntrial}``. The spec object
+    is yielded so tests can inspect ``spec.fired``."""
+    prev = getattr(_state, "spec", None)
+    spec = _FaultSpec(triggers)
+    _state.spec = spec
+    try:
+        yield spec
+    finally:
+        _state.spec = prev
+
+
+def begin_version(version: int) -> None:
+    """Round boundary: resets the seqno counter (rabit's version bump at
+    CheckPoint, ``allreduce_base.h:155``). Called by ``Booster.update``."""
+    spec = getattr(_state, "spec", None)
+    if spec is not None:
+        spec.version = version
+        spec.seqno = 0
+
+
+def inject(site: str) -> None:
+    """Injection site: no-op unless a spec is armed and the current
+    (version, seqno) has remaining trials. Sites are the per-round host
+    dispatch boundaries (gradient/grow/eval) — the places the reference
+    mock intercepts collectives."""
+    spec = getattr(_state, "spec", None)
+    if spec is None:
+        return
+    key = (spec.version, spec.seqno)
+    spec.seqno += 1
+    remaining = spec.triggers.get(key, 0)
+    if remaining > 0:
+        spec.triggers[key] = remaining - 1
+        trial = remaining
+        spec.fired.append((site, key[0], key[1]))
+        raise InjectedFault(site, key[0], key[1], trial)
