@@ -70,6 +70,10 @@ def _group_ptr_from_qid(qid: np.ndarray) -> np.ndarray:
 class DMatrix:
     """In-memory data matrix + metadata, the universal training/predict input."""
 
+    #: CSR storage when constructed from scipy sparse input (class-level
+    #: default so subclasses bypassing __init__ read None)
+    _sparse = None
+
     def __init__(
         self,
         data: Any,
@@ -88,10 +92,21 @@ class DMatrix:
         enable_categorical: bool = False,
         nthread: Optional[int] = None,  # accepted for API compat; single-controller
     ) -> None:
-        X, auto_names, auto_types, auto_label, auto_qid = dispatch_data(
-            data, missing=missing, enable_categorical=enable_categorical
-        )
-        self._data: np.ndarray = X
+        auto_names = auto_types = auto_label = auto_qid = None
+        if hasattr(data, "tocsr") and hasattr(data, "nnz"):
+            # scipy sparse stays sparse: no dense float materialization
+            # (reference SparsePage storage, include/xgboost/data.h:260);
+            # quantization streams column blocks (quantile.from_sparse)
+            from .sparse import CSRStorage
+
+            self._sparse: Optional["CSRStorage"] = CSRStorage(data, missing)
+            self._data = None
+        else:
+            X, auto_names, auto_types, auto_label, auto_qid = dispatch_data(
+                data, missing=missing, enable_categorical=enable_categorical
+            )
+            self._data: np.ndarray = X
+            self._sparse = None
         self.info = MetaInfo()
         self.info.feature_names = list(feature_names) if feature_names else auto_names
         self.info.feature_types = list(feature_types) if feature_types else auto_types
@@ -171,17 +186,29 @@ class DMatrix:
 
     # ---- shape ----
     def num_row(self) -> int:
+        if self._sparse is not None:
+            return int(self._sparse.shape[0])
         return int(self._data.shape[0])
 
     def num_col(self) -> int:
+        if self._sparse is not None:
+            return int(self._sparse.shape[1])
         return int(self._data.shape[1])
 
     def num_nonmissing(self) -> int:
+        if self._sparse is not None and self._data is None:
+            return self._sparse.nnz
         return int(np.count_nonzero(~np.isnan(self.data)))
 
     @property
     def data(self) -> np.ndarray:
-        """Dense [n, F] float32 with NaN missing."""
+        """Dense [n, F] float32 with NaN missing. For sparse-constructed
+        matrices this densifies ON FIRST TOUCH and caches — training and
+        batch prediction never call it (they stream blocks); feature paths
+        that need raw values wholesale (SHAP, gblinear, approx re-sketch,
+        exact cuts) do."""
+        if self._data is None and self._sparse is not None:
+            self._data = self._sparse.toarray()
         return self._data
 
     @property
@@ -260,6 +287,15 @@ class DMatrix:
             from ..parallel.mesh import current_mesh
 
             mesh = current_mesh()
+            if (self._sparse is not None and self._data is None
+                    and not (mesh is not None and mesh.devices.size > 1)):
+                # sparse fast path: column-blocked sketch + quantization,
+                # no dense float detour (under a mesh the distributed
+                # sketch needs the dense row shards — densify then)
+                return BinnedMatrix.from_sparse(
+                    self._sparse, max_bin=max_bin, weights=sketch_weights,
+                    categorical=cat,
+                )
             if mesh is not None and mesh.devices.size > 1:
                 # distributed sketch: per-shard summaries merged by
                 # all_gather (the quantile.cc:270 AllReduce site)
@@ -320,7 +356,11 @@ class DMatrix:
     def slice(self, rindex: Any) -> "DMatrix":
         rindex = np.asarray(rindex)
         out = DMatrix.__new__(DMatrix)
-        out._data = np.asarray(self.data)[rindex]
+        if self._sparse is not None and self._data is None:
+            out._sparse = self._sparse.slice_rows(rindex)
+            out._data = None
+        else:
+            out._data = np.asarray(self.data)[rindex]
         out.info = self.info.slice(rindex)
         out._binned = {}
         return out
@@ -350,10 +390,16 @@ class QuantileDMatrix(DMatrix):
             cuts = ref_bm.cuts
             if not cat:
                 cat = list(ref_bm.categorical)
-        self._binned[max_bin] = BinnedMatrix.from_dense(
-            self._data, max_bin=max_bin, weights=self.info.weight, cuts=cuts,
-            categorical=cat,
-        )
+        if self._sparse is not None and self._data is None:
+            self._binned[max_bin] = BinnedMatrix.from_sparse(
+                self._sparse, max_bin=max_bin, weights=self.info.weight,
+                cuts=cuts, categorical=cat,
+            )
+        else:
+            self._binned[max_bin] = BinnedMatrix.from_dense(
+                self._data, max_bin=max_bin, weights=self.info.weight,
+                cuts=cuts, categorical=cat,
+            )
 
 
 def load_row_split(uri, rank: int, world: int, **kwargs) -> "DMatrix":

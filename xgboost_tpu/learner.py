@@ -331,6 +331,7 @@ class Booster:
             entry is not None
             and self._gbm.name == "gbtree"
             and entry.margin is not None
+            and getattr(dmat, "_sparse", None) is None
             and 0 < entry.num_trees < cur
             # far behind (e.g. predicting after a long training run with no
             # intermediate evals): one full pass beats replaying per-round
@@ -352,6 +353,18 @@ class Booster:
             # empty model: don't touch dmat.data (streaming matrices
             # reconstruct raw values lazily — the zero-tree margin is base)
             margin = base
+        elif getattr(dmat, "_sparse", None) is not None and dmat._data is None:
+            # sparse input: densify ROW BLOCKS on the fly so a full dense
+            # float copy is never resident (reference predictors likewise
+            # walk SparsePage batches, cpu_predictor.cc)
+            blk = 65536
+            parts = []
+            for lo in range(0, n, blk):
+                hi = min(lo + blk, n)
+                parts.append(self._gbm.predict(
+                    dmat._sparse.dense_rows(lo, hi), base[lo:hi]))
+            margin = (jnp.concatenate(parts, axis=0) if len(parts) > 1
+                      else parts[0] if parts else base)
         else:
             margin = self._gbm.predict(dmat.data, base)
         if entry is not None and self._gbm.name == "gbtree":
