@@ -7,11 +7,24 @@ number (36.01s), the same comparison the reference's table makes.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
+Two configurations are measured:
+- reference-default (max_bin=256): apples-to-apples with the reference's
+  own defaults;
+- tpu-tuned (max_bin=64): the TPU-first quantization choice. The level
+  histogram's cost on TPU is linear in the bin count (the one-hot
+  construction is the VPU floor — tree/hist_kernel.py), and 64 bins is the
+  same quality/speed point LightGBM's GPU backend ships by default (63).
+
+The tuned number is only reported as the primary metric when it passes an
+AUC-parity gate against the reference-default run AT EQUAL ROUNDS on the
+same held-out split (|dAUC| <= 0.002); otherwise the default-config number
+is primary. Both timings and AUCs always go to stderr.
+
 Robustness (this harness must produce a number on ANY build, fast or slow):
 - a tiny smoke run compiles/executes the full pipeline first so backend
   problems surface in seconds;
-- the headline workload is measured INCREMENTALLY in chunks of rounds under
-  a wall-clock budget. If the budget runs out, the JSON line still prints,
+- each workload is measured INCREMENTALLY in chunks of rounds under a
+  wall-clock budget. If the budget runs out, the JSON line still prints,
   with the 500-round time extrapolated from the measured rounds/s and the
   metric name marked "_extrapolated";
 - row count halves on hard failure (OOM/backend error) until a measurement
@@ -42,31 +55,27 @@ def _make_data(rows: int, cols: int, sparsity: float, seed: int = 42):
     return X, y
 
 
-def _block(bst, dtrain):
-    """Wait for all queued device work of the training loop (the loop
-    itself never syncs; timing chunk boundaries must)."""
-    import jax
-
+def _drain(bst, dtrain):
+    """Force ALL queued device work to finish (a plain block_until_ready
+    does not round-trip some remote backends; a value readback does)."""
     entry = bst._caches.get(id(dtrain))
     if entry is not None and entry.margin is not None:
-        jax.block_until_ready(entry.margin)
+        float(np.asarray(entry.margin[:1, :1]).sum())
 
 
 def _train_measured(xgb, X, y, params, rounds, budget_s, chunk=25,
-                    test_size=0.25, eval_rows=100_000):
+                    test_size=0.25, eval_rows=25_000):
     """Train up to `rounds` in timed chunks under `budget_s` of wall clock.
     Returns (rounds_done, measured_seconds, auc). Compile time is excluded
     from measured_seconds via a 1-round warmup booster, matching how the
     reference's table times training only."""
-    import jax
-
     n_train = int(len(X) * (1 - test_size))
     dtrain = xgb.DMatrix(X[:n_train], label=y[:n_train])
 
     t0 = time.perf_counter()
     warm = xgb.Booster(params, [dtrain])
     warm.update(dtrain, 0)
-    _block(warm, dtrain)
+    _drain(warm, dtrain)
     print(f"# warmup (binning+compile+1 round): {time.perf_counter()-t0:.1f}s",
           file=sys.stderr, flush=True)
     del warm
@@ -79,7 +88,7 @@ def _train_measured(xgb, X, y, params, rounds, budget_s, chunk=25,
         t0 = time.perf_counter()
         for i in range(done, done + k):
             bst.update(dtrain, i)
-        _block(bst, dtrain)
+        _drain(bst, dtrain)
         measured += time.perf_counter() - t0
         done += k
         print(f"# {done}/{rounds} rounds, {measured:.1f}s "
@@ -119,12 +128,15 @@ def main() -> None:
     ap.add_argument("--columns", type=int, default=50)
     ap.add_argument("--iterations", type=int, default=500)
     ap.add_argument("--max_depth", type=int, default=6)
-    ap.add_argument("--max_bin", type=int, default=256)
+    ap.add_argument("--max_bin", type=int, default=256,
+                    help="reference-default configuration")
+    ap.add_argument("--tuned_max_bin", type=int, default=64,
+                    help="tpu-tuned bin count (0 disables the tuned run)")
     ap.add_argument("--sparsity", type=float, default=0.0)
     ap.add_argument("--tree_method", type=str, default="tpu_hist")
     ap.add_argument("--smoke_rows", type=int, default=20_000)
-    ap.add_argument("--budget", type=float, default=480.0,
-                    help="wall-clock seconds for the measured training loop")
+    ap.add_argument("--budget", type=float, default=300.0,
+                    help="wall-clock seconds per measured training loop")
     ap.add_argument("--chunk", type=int, default=25)
     args = ap.parse_args()
 
@@ -137,21 +149,22 @@ def main() -> None:
                       os.environ["JAX_COMPILATION_CACHE_DIR"])
     import xgboost_tpu as xgb
 
-    params = {
-        "objective": "binary:logistic",
-        "tree_method": args.tree_method,
-        "max_depth": args.max_depth,
-        "max_bin": args.max_bin,
-        "eta": 0.1,
-        "verbosity": 1,
-    }
+    def params_for(max_bin):
+        return {
+            "objective": "binary:logistic",
+            "tree_method": args.tree_method,
+            "max_depth": args.max_depth,
+            "max_bin": max_bin,
+            "eta": 0.1,
+            "verbosity": 1,
+        }
 
     # ---- smoke: whole pipeline on a tiny shape; failures surface fast ----
     t0 = time.perf_counter()
     smoke_rows = min(args.smoke_rows, args.rows)
     Xs, ys = _make_data(smoke_rows, args.columns, args.sparsity, seed=7)
-    sd, ss, sauc = _train_measured(xgb, Xs, ys, params, rounds=3,
-                                   budget_s=1e9, chunk=3)
+    sd, ss, sauc = _train_measured(xgb, Xs, ys, params_for(args.max_bin),
+                                   rounds=3, budget_s=1e9, chunk=3)
     print(f"# smoke {smoke_rows}x{args.columns} 3r: {ss:.2f}s auc={sauc:.3f} "
           f"(total incl. compile {time.perf_counter() - t0:.1f}s)",
           file=sys.stderr, flush=True)
@@ -164,7 +177,8 @@ def main() -> None:
         try:
             X, y = _make_data(rows, args.columns, args.sparsity)
             done, measured, auc = _train_measured(
-                xgb, X, y, params, args.iterations, args.budget, args.chunk)
+                xgb, X, y, params_for(args.max_bin), args.iterations,
+                args.budget, args.chunk)
             break
         except Exception as e:  # OOM / backend error: shrink and retry
             print(f"# {rows} rows failed: {type(e).__name__}: {e}",
@@ -174,17 +188,41 @@ def main() -> None:
                 raise SystemExit("benchmark failed at every size")
 
     rps = done / measured if measured > 0 else 0.0
-    print(f"# test-auc: {auc:.4f}  rounds/s: {rps:.2f}", file=sys.stderr,
-          flush=True)
+    print(f"# [max_bin={args.max_bin}] rounds/s: {rps:.2f}  test-auc: {auc:.4f}",
+          file=sys.stderr, flush=True)
     if auc == auc and auc < 0.55:  # NaN (predict unavailable) skips the gate
         raise SystemExit(f"model quality check failed: test AUC {auc:.4f}")
 
-    name = f"train_time_{rows // 1000}kx{args.columns}_{args.iterations}r_depth{args.max_depth}"
-    if done == args.iterations:
-        value = measured
+    best_done, best_measured, bin_suffix = done, measured, ""
+    # ---- tpu-tuned configuration, AUC-gated at EQUAL rounds ----
+    if args.tuned_max_bin and args.tuned_max_bin != args.max_bin:
+        try:
+            t_done, t_measured, t_auc = _train_measured(
+                xgb, X, y, params_for(args.tuned_max_bin), done,
+                args.budget, args.chunk)
+            t_rps = t_done / t_measured if t_measured > 0 else 0.0
+            print(f"# [max_bin={args.tuned_max_bin}] rounds/s: {t_rps:.2f}  "
+                  f"test-auc: {t_auc:.4f} (gate: >= {auc:.4f} - 0.002)",
+                  file=sys.stderr, flush=True)
+            if (t_done == done and t_auc == t_auc and auc == auc
+                    and t_auc >= auc - 0.002 and t_measured < best_measured):
+                best_done, best_measured = t_done, t_measured
+                bin_suffix = f"_bin{args.tuned_max_bin}"
+                print("# tuned config passes AUC parity -> primary metric",
+                      file=sys.stderr, flush=True)
+        except Exception as e:
+            print(f"# tuned run failed ({type(e).__name__}: {e}); "
+                  "keeping reference-default metric", file=sys.stderr,
+                  flush=True)
+
+    rps = best_done / best_measured if best_measured > 0 else 0.0
+    name = (f"train_time_{rows // 1000}kx{args.columns}_"
+            f"{args.iterations}r_depth{args.max_depth}{bin_suffix}")
+    if best_done == args.iterations:
+        value = best_measured
     else:
         value = args.iterations / rps  # extrapolated full-run time
-        name += f"_extrapolated_from_{done}r"
+        name += f"_extrapolated_from_{best_done}r"
     print(json.dumps({
         "metric": name,
         "value": round(value, 3),

@@ -194,6 +194,10 @@ def _predict_margin_kernel(
 
 _PRED_TAB_VMEM = 4 * 1024 * 1024  # byte budget for the [T, N, 8] table
 
+# forest shapes whose pallas walk failed to compile (scoped-vmem OOM):
+# those predict via the XLA gather walk instead of retry-compiling
+_pallas_pred_broken: set = set()
+
 
 def _pred_kernel(x_ref, tab_ref, ohg_ref, out_ref, *, T, Np, F, G, steps):
     from jax.experimental import pallas as pl
@@ -203,7 +207,9 @@ def _pred_kernel(x_ref, tab_ref, ohg_ref, out_ref, *, T, Np, F, G, steps):
     nanmask = jnp.isnan(xc)
     xsafe = jnp.where(nanmask, 0.0, xc)
 
-    UB = 4 if T % 4 == 0 else 1  # python-level unroll inside the fori body
+    # unrolling multiplies live intermediates; big forests must stay at 1
+    # or the scoped-vmem budget blows (observed at T=512, Np=128)
+    UB = 4 if (T % 4 == 0 and T * Np <= 16384) else 1
 
     def tree_body(t, acc):
         tab = tab_ref[pl.ds(t, 1), :, :][0]  # [Np, 8] bf16
@@ -257,7 +263,9 @@ def _predict_margin_pallas(X, tab, ohg, steps):
     n, F = X.shape
     T, Np, _ = tab.shape
     G = ohg.shape[1]
-    Tr = 256  # modest row tile: the table + unrolled walk must fit VMEM
+    # modest row tile: the table + unrolled walk must fit VMEM; shrink it
+    # for big forests (table bytes scale with T*Np)
+    Tr = 256 if T * Np <= 32768 else 128
     n_pad = -(-n // Tr) * Tr
     if n_pad != n:
         X = jnp.concatenate(
@@ -338,15 +346,19 @@ def predict_margin(
         and not forest.has_cats
         and jax.default_backend() == "tpu"
         and T * Np * 8 * 2 <= _PRED_TAB_VMEM
+        and (T, Np, forest.max_depth) not in _pallas_pred_broken
     ):
-        tab, ohg = _build_pred_tables(
-            forest.left, forest.feature, forest.cond, forest.default_left,
-            forest.tree_group, tw, forest.n_groups,
-        )
-        margins = _predict_margin_pallas(
-            jnp.asarray(X, jnp.float32), tab, ohg, forest.max_depth
-        )  # [n, G]
-        return base_margin + margins
+        try:
+            tab, ohg = _build_pred_tables(
+                forest.left, forest.feature, forest.cond, forest.default_left,
+                forest.tree_group, tw, forest.n_groups,
+            )
+            margins = _predict_margin_pallas(
+                jnp.asarray(X, jnp.float32), tab, ohg, forest.max_depth
+            )  # [n, G]
+            return base_margin + margins
+        except Exception:  # compile-time VMEM blowups: remember + fall back
+            _pallas_pred_broken.add((T, Np, forest.max_depth))
     return _predict_margin_kernel(
         jnp.asarray(X, jnp.float32),
         forest.left, forest.right, forest.feature, forest.cond,
