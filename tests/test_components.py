@@ -544,3 +544,32 @@ def test_tree_method_exact_sparse_categorical_codes():
                      "tree_method": "exact", "eta": 1.0}, d, 1,
                     verbose_eval=False)
     assert ((bst.predict(d) > 0.5) == y.astype(bool)).all()
+
+
+def test_update_many_scan_matches_per_round_updates():
+    """update_many = one lax.scan dispatch per chunk; same RNG keys as the
+    per-round path, so the trees match (float-fusion noise only)."""
+    rng = np.random.RandomState(0)
+    X = rng.randn(3000, 8).astype(np.float32)
+    X[rng.rand(3000, 8) < 0.05] = np.nan
+    y = (np.nan_to_num(X).sum(1) > 0).astype(np.float32)
+    params = {"objective": "binary:logistic", "max_depth": 4, "eta": 0.3,
+              "subsample": 0.8, "colsample_bytree": 0.7, "seed": 9}
+
+    d1 = xgb.DMatrix(X, label=y)
+    b1 = xgb.Booster(params, [d1])
+    for i in range(8):
+        b1.update(d1, i)
+    d2 = xgb.DMatrix(X, label=y)
+    b2 = xgb.Booster(params, [d2])
+    b2.update_many(d2, 0, 8, chunk=3)  # uneven chunks: 3+3+2
+    np.testing.assert_allclose(b1.predict(d1), b2.predict(d2),
+                               rtol=1e-5, atol=1e-6)
+    assert b2.num_boosted_rounds() == 8
+
+    # ineligible configs fall back to the per-round path transparently
+    dm = xgb.DMatrix(X, label=(y + (np.nan_to_num(X)[:, 0] > 1)).clip(0, 2))
+    bm = xgb.Booster({"objective": "multi:softprob", "num_class": 3,
+                      "max_depth": 3}, [dm])
+    bm.update_many(dm, 0, 3)
+    assert bm.num_boosted_rounds() == 3

@@ -10,6 +10,7 @@ line from DropTrees:914 / NormalizeTrees:963).
 from __future__ import annotations
 
 import dataclasses as _dc
+import functools
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -383,6 +384,33 @@ class GBTreeModel:
             for t in range(r * per_round, min((r + 1) * per_round, len(trees))):
                 out.add(trees[t], self.tree_info[t])
         return out
+
+
+@functools.partial(jax.jit, static_argnames=("obj", "cfg", "n", "n_pad"))
+def _scan_rounds_impl(binsf, label, weight, m_pad, iters, cut_vals, eta,
+                      gamma, fw, seed_base, *, obj, cfg, n, n_pad):
+    """Multi-round boosting as one program: scan body = gradient -> fused
+    tree -> margin update. Cache key includes the objective INSTANCE (its
+    params are read at trace time) and the static grow config; equal-length
+    chunks reuse the compile."""
+
+    def body(m_pad, i):
+        g, h = obj.get_gradient(m_pad[:n], label, weight, i)
+        if n_pad != n:
+            pad = jnp.zeros((n_pad - n,), jnp.float32)
+            g = jnp.concatenate([g, pad])
+            h = jnp.concatenate([h, pad])
+        # bit-identical to boost_one_round's python-int key formula: the
+        # 31-bit mask reads only low bits, which uint32 arithmetic keeps
+        seed = (seed_base + i.astype(jnp.uint32) * jnp.uint32(131)) \
+            & jnp.uint32(0x7FFFFFFF)
+        key = jax.random.PRNGKey(seed.astype(jnp.int32))
+        t = grow_tree_fused(binsf, g, h, cut_vals, key, eta, gamma, cfg,
+                            feature_weights=fw)
+        m_pad = m_pad + t.delta
+        return m_pad, t._replace(delta=jnp.zeros((0,), jnp.float32))
+
+    return jax.lax.scan(body, m_pad, iters)
 
 
 @BOOSTERS.register("gbtree")
@@ -848,6 +876,75 @@ class GBTree:
                     else:
                         margin_cache = margin_cache + delta
         return new_trees, margin_cache
+
+    def scan_rounds_supported(self, binned, obj, n_groups: int) -> bool:
+        """Whether ``boost_rounds_scan`` can run: the single-group fused
+        path with a scan-safe (elementwise) objective."""
+        tp = self.train_param
+        return (
+            self.name == "gbtree"
+            and n_groups == 1
+            and self.gbtree_param.num_parallel_tree == 1
+            and not self._is_update_process
+            and getattr(obj, "scan_safe", False)
+            and tp.grow_policy != "lossguide"
+            and not tuple(getattr(binned, "categorical", ()))
+            and not getattr(binned, "is_paged", False)
+        )
+
+    def boost_rounds_scan(
+        self,
+        binned,
+        obj,
+        label: jax.Array,  # [n]
+        weight,  # [n] or None
+        margin: jax.Array,  # [n, 1]
+        start_iteration: int,
+        num_rounds: int,
+        feature_weights=None,
+    ) -> jax.Array:
+        """``num_rounds`` boosting rounds as ONE compiled program: a
+        ``lax.scan`` whose body is gradient -> fused tree build -> margin
+        update, with per-tree heap arrays stacked as scan outputs. One
+        dispatch replaces ~10 x num_rounds host round-trips — the
+        whole-training-loop-on-device design point the reference cannot
+        reach (its DoBoost crosses Python/C/driver boundaries every round,
+        ``gbtree.cc:219``). Per-round RNG keys reproduce ``boost_one_round``
+        exactly; results match the per-round path to float-fusion noise."""
+        from ..parallel.mesh import current_mesh
+
+        tp = self.train_param
+        cfg = self._grow_params()
+        mesh = current_mesh()
+        assert mesh is None or mesh.devices.size == 1, (
+            "boost_rounds_scan is single-device; mesh training uses the "
+            "per-round path"
+        )
+        n = binned.n_rows
+        binsf, n_pad = binned.fused_bins()
+        cut_vals = jnp.asarray(binned.cuts.values)
+        fw = (jnp.asarray(feature_weights)
+              if feature_weights is not None else None)
+        eta = jnp.float32(tp.eta)
+        gamma = jnp.float32(tp.gamma)
+        label = jnp.asarray(label, jnp.float32)
+        weight_j = jnp.asarray(weight, jnp.float32) if weight is not None else None
+        seed_base = np.uint32((tp.seed * 1000003) & 0xFFFFFFFF)
+
+        m_pad = margin[:, 0]
+        if n_pad != n:
+            m_pad = jnp.concatenate(
+                [m_pad, jnp.zeros((n_pad - n,), jnp.float32)])
+        iters = jnp.arange(start_iteration, start_iteration + num_rounds,
+                           dtype=jnp.int32)
+        m_pad, stacked = _scan_rounds_impl(
+            binsf, label, weight_j, m_pad, iters, cut_vals, eta, gamma, fw,
+            jnp.uint32(seed_base), obj=obj, cfg=cfg, n=n, n_pad=n_pad,
+        )
+        for r in range(num_rounds):
+            grown = jax.tree_util.tree_map(lambda a, r=r: a[r], stacked)
+            self.model.add_device(grown, tp.eta, 0, tp.max_depth)
+        return m_pad[:n][:, None]
 
     # ------------------------------------------------------------------
     def training_margin(self, X, base_margin: jax.Array) -> jax.Array:

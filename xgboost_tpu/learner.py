@@ -189,6 +189,53 @@ class Booster:
         self._do_boost(dtrain, grad, hess, iteration)
         self.monitor.maybe_print()
 
+    def update_many(self, dtrain: DMatrix, start_iteration: int,
+                    num_rounds: int, chunk: int = 25) -> None:
+        """``num_rounds`` boosting rounds with ONE device dispatch per
+        ``chunk`` rounds (a ``lax.scan`` over the fused round program,
+        ``gbm/gbtree.py:boost_rounds_scan``) — same trees as calling
+        ``update`` per round (identical RNG keys). Falls back to the per-round path whenever the
+        configuration is outside the scan-safe envelope (multiclass,
+        ranking/survival objectives, DART, lossguide, categorical,
+        external memory, mesh, custom objective)."""
+        self._configure()
+        from .parallel.mesh import current_mesh
+
+        mesh = current_mesh()
+        binned = None
+        if (
+            self._gbm.name == "gbtree"
+            and not getattr(self._gbm, "needs_iteration_sketch", False)
+            and not getattr(self._gbm, "needs_exact_cuts", False)
+            and (mesh is None or mesh.devices.size == 1)
+            and dtrain.info.label is not None
+        ):
+            binned = dtrain.get_binned(self._gbm.train_param.max_bin,
+                                       dtrain.info.weight)
+        if binned is None or not self._gbm.scan_rounds_supported(
+                binned, self._obj, self.n_groups):
+            for i in range(start_iteration, start_iteration + num_rounds):
+                self.update(dtrain, i)
+            return
+        entry = self._caches.setdefault(id(dtrain), _PredCache())
+        done = 0
+        while done < num_rounds:
+            k = min(chunk, num_rounds - done)
+            fault.begin_version(start_iteration + done)
+            fault.inject("gradient")
+            fault.inject("grow")
+            margin = self._cached_margin(dtrain)
+            info = dtrain.info
+            margin = self._gbm.boost_rounds_scan(
+                binned, self._obj,
+                jnp.asarray(info.label), info.weight, margin,
+                start_iteration + done, k,
+                feature_weights=info.feature_weights,
+            )
+            entry.margin = margin
+            entry.num_trees = self._gbm.model.num_trees
+            done += k
+
     def boost(self, dtrain: DMatrix, grad, hess) -> None:
         """Custom-objective boost (reference BoostOneIter learner.cc:1088)."""
         self._configure()

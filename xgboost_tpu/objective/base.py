@@ -26,6 +26,9 @@ class ObjFunction:
 
     task: Task = Task.REGRESSION
     name: str = ""
+    #: elementwise, jax-traceable gradient with no group/bound state — safe
+    #: to trace inside a multi-round lax.scan (Booster.update_many)
+    scan_safe: bool = False
 
     def __init__(self, params=None):
         self.params = params

@@ -190,3 +190,11 @@ class Tweedie(ObjFunction):
     def default_metric(self):
         rho = getattr(self.params, "tweedie_variance_power", 1.5) if self.params else 1.5
         return f"tweedie-nloglik@{rho}"
+
+
+# every objective in this module is elementwise and jax-traceable: safe to
+# trace inside the multi-round scan (learner.Booster.update_many)
+for _cls in (SquaredError, SquaredLogError, PseudoHuber, BinaryLogistic,
+             RegLogistic, LogitRaw, Hinge, Poisson, GammaDeviance, Tweedie):
+    _cls.scan_safe = True
+del _cls
