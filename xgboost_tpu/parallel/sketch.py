@@ -95,13 +95,24 @@ def distributed_compute_cuts(
         g_wts = jax.lax.all_gather(wts, ROW_AXIS)
         g_max = jax.lax.all_gather(fmax, ROW_AXIS)
         g_min = jax.lax.all_gather(fmin, ROW_AXIS)
-        return _merge_summaries(g_vals, g_wts, g_max, g_min, max_bin)
+        cuts, mins = _merge_summaries(g_vals, g_wts, g_max, g_min, max_bin)
+        # every shard computed identical cuts, but the VMA type system
+        # cannot credit that through all_gather; an exact rank-0
+        # psum-broadcast (the reference's tree-sync site,
+        # updater_sync.cc:20) makes the replication provable so shard_map
+        # verifies it (check_vma on)
+        r = jax.lax.axis_index(ROW_AXIS)
+
+        def bcast0(a):
+            return jax.lax.psum(jnp.where(r == 0, a, jnp.zeros_like(a)),
+                                ROW_AXIS)
+
+        return bcast0(cuts), bcast0(mins)
 
     cuts, min_vals = jax.shard_map(
         shard_fn,
         mesh=mesh,
         in_specs=(P(ROW_AXIS, None), P(ROW_AXIS)),
         out_specs=(P(), P()),
-        check_vma=False,
     )(X, weights)
     return HistogramCuts(values=np.asarray(cuts), min_vals=np.asarray(min_vals))
