@@ -37,6 +37,12 @@ def train(
     callbacks = list(callbacks) if callbacks else []
     evals = list(evals) if evals else []
     feval = custom_metric if custom_metric is not None else feval
+    # scan fast-path eligibility, decided on USER-supplied state before the
+    # auto-added monitor/early-stop callbacks join the list
+    _no_per_iter_consumer = (
+        not evals and not callbacks and obj is None and feval is None
+        and early_stopping_rounds is None
+    )
 
     if verbose_eval:
         period = verbose_eval if isinstance(verbose_eval, int) and not isinstance(verbose_eval, bool) else 1
@@ -60,12 +66,19 @@ def train(
     container = CallbackContainer(callbacks)
     bst = container.before_training(bst)
 
-    for i in range(start_round, start_round + num_boost_round):
-        if container.before_iteration(bst, i, dtrain, evals):
-            break
-        bst.update(dtrain, i, fobj=obj)
-        if container.after_iteration(bst, i, dtrain, evals, feval=feval):
-            break
+    if _no_per_iter_consumer:
+        # no per-iteration consumer (no eval lines, early stopping,
+        # checkpoints or custom callbacks): train whole chunks as single
+        # scan dispatches (Booster.update_many; falls back per-round for
+        # ineligible configs)
+        bst.update_many(dtrain, start_round, num_boost_round)
+    else:
+        for i in range(start_round, start_round + num_boost_round):
+            if container.before_iteration(bst, i, dtrain, evals):
+                break
+            bst.update(dtrain, i, fobj=obj)
+            if container.after_iteration(bst, i, dtrain, evals, feval=feval):
+                break
 
     bst = container.after_training(bst)
 
