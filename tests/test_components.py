@@ -567,9 +567,23 @@ def test_update_many_scan_matches_per_round_updates():
                                rtol=1e-5, atol=1e-6)
     assert b2.num_boosted_rounds() == 8
 
-    # ineligible configs fall back to the per-round path transparently
-    dm = xgb.DMatrix(X, label=(y + (np.nan_to_num(X)[:, 0] > 1)).clip(0, 2))
-    bm = xgb.Booster({"objective": "multi:softprob", "num_class": 3,
-                      "max_depth": 3}, [dm])
-    bm.update_many(dm, 0, 3)
-    assert bm.num_boosted_rounds() == 3
+    # multiclass: one tree per group per round inside the scan
+    ym = (y + (np.nan_to_num(X)[:, 0] > 1)).clip(0, 2)
+    d3 = xgb.DMatrix(X, label=ym)
+    b3 = xgb.Booster({"objective": "multi:softprob", "num_class": 3,
+                      "max_depth": 3, "seed": 4}, [d3])
+    for i in range(3):
+        b3.update(d3, i)
+    d4 = xgb.DMatrix(X, label=ym)
+    b4 = xgb.Booster({"objective": "multi:softprob", "num_class": 3,
+                      "max_depth": 3, "seed": 4}, [d4])
+    b4.update_many(d4, 0, 3)
+    np.testing.assert_allclose(b3.predict(d3), b4.predict(d4),
+                               rtol=1e-5, atol=1e-6)
+
+    # ineligible configs (DART here) fall back per-round transparently
+    db = xgb.DMatrix(X, label=y)
+    bb = xgb.Booster({"booster": "dart", "objective": "binary:logistic",
+                      "max_depth": 3}, [db])
+    bb.update_many(db, 0, 3)
+    assert bb.num_boosted_rounds() == 3

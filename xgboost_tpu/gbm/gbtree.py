@@ -386,29 +386,40 @@ class GBTreeModel:
         return out
 
 
-@functools.partial(jax.jit, static_argnames=("obj", "cfg", "n", "n_pad"))
+@functools.partial(jax.jit,
+                   static_argnames=("obj", "cfg", "n", "n_pad", "n_groups"))
 def _scan_rounds_impl(binsf, label, weight, m_pad, iters, cut_vals, eta,
-                      gamma, fw, seed_base, *, obj, cfg, n, n_pad):
+                      gamma, fw, seed_base, *, obj, cfg, n, n_pad, n_groups):
     """Multi-round boosting as one program: scan body = gradient -> fused
-    tree -> margin update. Cache key includes the objective INSTANCE (its
-    params are read at trace time) and the static grow config; equal-length
-    chunks reuse the compile."""
+    tree(s) -> margin update (one tree per output group, like DoBoost's
+    per-group gradient slicing, gbtree.cc:219). Cache key includes the
+    objective INSTANCE (its params are read at trace time) and the static
+    grow config; equal-length chunks reuse the compile."""
+    K = n_groups
+
+    def pad0(v):
+        if n_pad == n:
+            return v
+        return jnp.concatenate([v, jnp.zeros((n_pad - n,), jnp.float32)])
 
     def body(m_pad, i):
-        g, h = obj.get_gradient(m_pad[:n], label, weight, i)
-        if n_pad != n:
-            pad = jnp.zeros((n_pad - n,), jnp.float32)
-            g = jnp.concatenate([g, pad])
-            h = jnp.concatenate([h, pad])
-        # bit-identical to boost_one_round's python-int key formula: the
-        # 31-bit mask reads only low bits, which uint32 arithmetic keeps
-        seed = (seed_base + i.astype(jnp.uint32) * jnp.uint32(131)) \
-            & jnp.uint32(0x7FFFFFFF)
-        key = jax.random.PRNGKey(seed.astype(jnp.int32))
-        t = grow_tree_fused(binsf, g, h, cut_vals, key, eta, gamma, cfg,
-                            feature_weights=fw)
-        m_pad = m_pad + t.delta
-        return m_pad, t._replace(delta=jnp.zeros((0,), jnp.float32))
+        m = m_pad[:n, 0] if K == 1 else m_pad[:n]
+        g, h = obj.get_gradient(m, label, weight, i)
+        trees = []
+        for k in range(K):
+            gk = pad0(g[:, k] if g.ndim == 2 else g)
+            hk = pad0(h[:, k] if h.ndim == 2 else h)
+            # bit-identical to boost_one_round's python-int key formula:
+            # the 31-bit mask reads only low bits, which uint32 keeps
+            seed = (seed_base + i.astype(jnp.uint32) * jnp.uint32(131)
+                    + jnp.uint32(k * 17)) & jnp.uint32(0x7FFFFFFF)
+            key = jax.random.PRNGKey(seed.astype(jnp.int32))
+            t = grow_tree_fused(binsf, gk, hk, cut_vals, key, eta, gamma,
+                                cfg, feature_weights=fw)
+            m_pad = m_pad.at[:, k].add(t.delta)
+            trees.append(t._replace(delta=jnp.zeros((0,), jnp.float32)))
+        stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *trees)
+        return m_pad, stacked
 
     return jax.lax.scan(body, m_pad, iters)
 
@@ -878,12 +889,12 @@ class GBTree:
         return new_trees, margin_cache
 
     def scan_rounds_supported(self, binned, obj, n_groups: int) -> bool:
-        """Whether ``boost_rounds_scan`` can run: the single-group fused
-        path with a scan-safe (elementwise) objective."""
+        """Whether ``boost_rounds_scan`` can run: the fused depthwise
+        path with a scan-safe (jax-traceable, groupless-state) objective;
+        one tree per output group per round."""
         tp = self.train_param
         return (
             self.name == "gbtree"
-            and n_groups == 1
             and self.gbtree_param.num_parallel_tree == 1
             and not self._is_update_process
             and getattr(obj, "scan_safe", False)
@@ -931,20 +942,24 @@ class GBTree:
         weight_j = jnp.asarray(weight, jnp.float32) if weight is not None else None
         seed_base = np.uint32((tp.seed * 1000003) & 0xFFFFFFFF)
 
-        m_pad = margin[:, 0]
+        K = self.n_groups
+        m_pad = margin
         if n_pad != n:
             m_pad = jnp.concatenate(
-                [m_pad, jnp.zeros((n_pad - n,), jnp.float32)])
+                [m_pad, jnp.zeros((n_pad - n, K), jnp.float32)])
         iters = jnp.arange(start_iteration, start_iteration + num_rounds,
                            dtype=jnp.int32)
         m_pad, stacked = _scan_rounds_impl(
             binsf, label, weight_j, m_pad, iters, cut_vals, eta, gamma, fw,
             jnp.uint32(seed_base), obj=obj, cfg=cfg, n=n, n_pad=n_pad,
+            n_groups=K,
         )
         for r in range(num_rounds):
-            grown = jax.tree_util.tree_map(lambda a, r=r: a[r], stacked)
-            self.model.add_device(grown, tp.eta, 0, tp.max_depth)
-        return m_pad[:n][:, None]
+            for k in range(K):
+                grown = jax.tree_util.tree_map(
+                    lambda a, r=r, k=k: a[r, k], stacked)
+                self.model.add_device(grown, tp.eta, k, tp.max_depth)
+        return m_pad[:n]
 
     # ------------------------------------------------------------------
     def training_margin(self, X, base_margin: jax.Array) -> jax.Array:

@@ -14,6 +14,7 @@ _EPS = 1e-16
 
 class _SoftmaxBase(ObjFunction):
     task = Task.CLASSIFICATION
+    scan_safe = True  # pure jnp rowwise softmax: traceable in update_many
 
     def n_targets(self) -> int:
         nc = getattr(self.params, "num_class", 0) if self.params else 0

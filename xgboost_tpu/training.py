@@ -66,11 +66,16 @@ def train(
     container = CallbackContainer(callbacks)
     bst = container.before_training(bst)
 
-    if _no_per_iter_consumer:
+    import jax
+
+    if _no_per_iter_consumer and jax.default_backend() == "tpu":
         # no per-iteration consumer (no eval lines, early stopping,
         # checkpoints or custom callbacks): train whole chunks as single
         # scan dispatches (Booster.update_many; falls back per-round for
-        # ineligible configs)
+        # ineligible configs). TPU-only: the scan amortizes dispatch
+        # latency, which is what accelerator backends pay; on CPU it only
+        # multiplies XLA:CPU compile load (observed LLVM segfaults under
+        # the full-suite compile volume), so the classic loop stays.
         bst.update_many(dtrain, start_round, num_boost_round)
     else:
         for i in range(start_round, start_round + num_boost_round):
