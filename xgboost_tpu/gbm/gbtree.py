@@ -386,10 +386,28 @@ class GBTreeModel:
         return out
 
 
+def _obj_fingerprint(obj) -> tuple:
+    """Hashable snapshot of the scalar params an objective can read at
+    trace time. Part of the scan's static jit key so mutating params via
+    set_param between update_many calls retraces instead of silently
+    reusing gradients compiled with the old values."""
+    p = getattr(obj, "params", None)
+    fields = getattr(p, "FIELDS", None)
+    if p is None or not fields:
+        return ()
+    return tuple(
+        (k, v) for k in sorted(fields)
+        for v in (getattr(p, k, None),)
+        if isinstance(v, (int, float, str, bool, type(None)))
+    )
+
+
 @functools.partial(jax.jit,
-                   static_argnames=("obj", "cfg", "n", "n_pad", "n_groups"))
+                   static_argnames=("obj", "obj_fp", "cfg", "n", "n_pad",
+                                    "n_groups"))
 def _scan_rounds_impl(binsf, label, weight, m_pad, iters, cut_vals, eta,
-                      gamma, fw, seed_base, *, obj, cfg, n, n_pad, n_groups):
+                      gamma, fw, seed_base, *, obj, obj_fp, cfg, n, n_pad,
+                      n_groups):
     """Multi-round boosting as one program: scan body = gradient -> fused
     tree(s) -> margin update (one tree per output group, like DoBoost's
     per-group gradient slicing, gbtree.cc:219). Cache key includes the
@@ -951,8 +969,8 @@ class GBTree:
                            dtype=jnp.int32)
         m_pad, stacked = _scan_rounds_impl(
             binsf, label, weight_j, m_pad, iters, cut_vals, eta, gamma, fw,
-            jnp.uint32(seed_base), obj=obj, cfg=cfg, n=n, n_pad=n_pad,
-            n_groups=K,
+            jnp.uint32(seed_base), obj=obj, obj_fp=_obj_fingerprint(obj),
+            cfg=cfg, n=n, n_pad=n_pad, n_groups=K,
         )
         for r in range(num_rounds):
             for k in range(K):

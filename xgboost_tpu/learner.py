@@ -195,9 +195,10 @@ class Booster:
         ``chunk`` rounds (a ``lax.scan`` over the fused round program,
         ``gbm/gbtree.py:boost_rounds_scan``) — same trees as calling
         ``update`` per round (identical RNG keys). Falls back to the per-round path whenever the
-        configuration is outside the scan-safe envelope (multiclass,
-        ranking/survival objectives, DART, lossguide, categorical,
-        external memory, mesh, custom objective)."""
+        configuration is outside the scan-safe envelope (ranking/survival
+        objectives, DART, lossguide, categorical, external memory, mesh,
+        custom objective); multiclass is supported (one tree per group
+        per scanned round)."""
         self._configure()
         from .parallel.mesh import current_mesh
 

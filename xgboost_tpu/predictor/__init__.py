@@ -346,7 +346,8 @@ def predict_margin(
         and not forest.has_cats
         and jax.default_backend() == "tpu"
         and T * Np * 8 * 2 <= _PRED_TAB_VMEM
-        and (T, Np, forest.max_depth) not in _pallas_pred_broken
+        and (T, Np, forest.max_depth, X.shape[1], forest.n_groups)
+        not in _pallas_pred_broken
     ):
         try:
             tab, ohg = _build_pred_tables(
@@ -357,8 +358,14 @@ def predict_margin(
                 jnp.asarray(X, jnp.float32), tab, ohg, forest.max_depth
             )  # [n, G]
             return base_margin + margins
-        except Exception:  # compile-time VMEM blowups: remember + fall back
-            _pallas_pred_broken.add((T, Np, forest.max_depth))
+        except Exception as e:
+            # compile-time blowups (scoped-vmem OOM, Mosaic rejects) are
+            # permanent for this shape: remember them. Transient runtime
+            # errors still fall back this call but may retry later.
+            msg = str(e).lower()
+            if any(t in msg for t in ("vmem", "mosaic", "compile")):
+                _pallas_pred_broken.add(
+                    (T, Np, forest.max_depth, X.shape[1], forest.n_groups))
     return _predict_margin_kernel(
         jnp.asarray(X, jnp.float32),
         forest.left, forest.right, forest.feature, forest.cond,
