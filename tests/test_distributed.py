@@ -175,3 +175,32 @@ def test_train_under_mesh_lossguide():
     np.testing.assert_allclose(
         b1.predict(d), b2.predict(d), rtol=1e-4, atol=1e-5
     )
+
+
+def test_mesh_update_many_scan_matches_per_round():
+    """The whole-chunk shard_map scan (distributed_boost_rounds_scan) must
+    reproduce mesh per-round training on shared cuts."""
+    import xgboost_tpu as xgb
+    from xgboost_tpu.parallel import mesh_context
+
+    rng = np.random.RandomState(4)
+    X = rng.randn(2051, 6).astype(np.float32)  # not divisible: padding path
+    y = (X.sum(1) > 0).astype(np.float32)
+    params = {"objective": "binary:logistic", "max_depth": 3, "eta": 0.4,
+              "subsample": 0.9, "seed": 3}
+    mesh = make_mesh(8)
+    with mesh_context(mesh):
+        d1 = xgb.DMatrix(X, label=y)
+        d1.get_binned(256)
+        b1 = xgb.Booster(params, [d1])
+        b1.update_many(d1, 0, 6, chunk=4)
+        p1 = b1.predict(d1)
+
+        d2 = xgb.DMatrix(X, label=y)
+        d2._binned = d1._binned  # identical distributed-sketch cuts
+        b2 = xgb.Booster(params, [d2])
+        for i in range(6):
+            b2.update(d2, i)
+        p2 = b2.predict(d2)
+    assert b1.num_boosted_rounds() == 6
+    np.testing.assert_allclose(p1, p2, rtol=1e-4, atol=1e-5)

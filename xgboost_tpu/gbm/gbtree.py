@@ -939,18 +939,20 @@ class GBTree:
         whole-training-loop-on-device design point the reference cannot
         reach (its DoBoost crosses Python/C/driver boundaries every round,
         ``gbtree.cc:219``). Per-round RNG keys reproduce ``boost_one_round``
-        exactly; results match the per-round path to float-fusion noise."""
-        from ..parallel.mesh import current_mesh
+        exactly; results match the per-round path to float-fusion noise.
+        Under an active mesh the whole chunk runs inside one shard_map
+        (distributed_boost_rounds_scan)."""
+        from ..parallel.mesh import current_mesh, shard_rows
 
         tp = self.train_param
         cfg = self._grow_params()
         mesh = current_mesh()
-        assert mesh is None or mesh.devices.size == 1, (
-            "boost_rounds_scan is single-device; mesh training uses the "
-            "per-round path"
-        )
+        use_mesh = mesh is not None and mesh.devices.size > 1
         n = binned.n_rows
-        binsf, n_pad = binned.fused_bins()
+        if use_mesh:
+            binsf, n_pad = binned.fused_bins_mesh(mesh)
+        else:
+            binsf, n_pad = binned.fused_bins()
         cut_vals = jnp.asarray(binned.cuts.values)
         fw = (jnp.asarray(feature_weights)
               if feature_weights is not None else None)
@@ -965,13 +967,29 @@ class GBTree:
         if n_pad != n:
             m_pad = jnp.concatenate(
                 [m_pad, jnp.zeros((n_pad - n, K), jnp.float32)])
+            label = jnp.concatenate(
+                [label, jnp.zeros((n_pad - n,), jnp.float32)])
+            if weight_j is not None:
+                weight_j = jnp.concatenate(
+                    [weight_j, jnp.zeros((n_pad - n,), jnp.float32)])
         iters = jnp.arange(start_iteration, start_iteration + num_rounds,
                            dtype=jnp.int32)
-        m_pad, stacked = _scan_rounds_impl(
-            binsf, label, weight_j, m_pad, iters, cut_vals, eta, gamma, fw,
-            jnp.uint32(seed_base), obj=obj, obj_fp=_obj_fingerprint(obj),
-            cfg=cfg, n=n, n_pad=n_pad, n_groups=K,
-        )
+        if use_mesh:
+            from ..parallel.grow import distributed_boost_rounds_scan
+
+            m_pad, stacked = distributed_boost_rounds_scan(
+                mesh, obj, binsf, shard_rows(label, mesh),
+                shard_rows(weight_j, mesh) if weight_j is not None else None,
+                shard_rows(m_pad, mesh), iters, cut_vals, eta, gamma, fw,
+                jnp.uint32(seed_base), n, cfg,
+            )
+        else:
+            m_pad, stacked = _scan_rounds_impl(
+                binsf, label[:n], weight_j[:n] if weight_j is not None else None,
+                m_pad, iters, cut_vals, eta, gamma, fw,
+                jnp.uint32(seed_base), obj=obj, obj_fp=_obj_fingerprint(obj),
+                cfg=cfg, n=n, n_pad=n_pad, n_groups=K,
+            )
         for r in range(num_rounds):
             for k in range(K):
                 grown = jax.tree_util.tree_map(

@@ -196,19 +196,16 @@ class Booster:
         ``gbm/gbtree.py:boost_rounds_scan``) — same trees as calling
         ``update`` per round (identical RNG keys). Falls back to the per-round path whenever the
         configuration is outside the scan-safe envelope (ranking/survival
-        objectives, DART, lossguide, categorical, external memory, mesh,
-        custom objective); multiclass is supported (one tree per group
-        per scanned round)."""
+        objectives, DART, lossguide, categorical, external memory, custom
+        objective); multiclass (one tree per group per scanned round) and
+        mesh training (the chunk scan runs inside one shard_map) are
+        supported."""
         self._configure()
-        from .parallel.mesh import current_mesh
-
-        mesh = current_mesh()
         binned = None
         if (
             self._gbm.name == "gbtree"
             and not getattr(self._gbm, "needs_iteration_sketch", False)
             and not getattr(self._gbm, "needs_exact_cuts", False)
-            and (mesh is None or mesh.devices.size == 1)
             and dtrain.info.label is not None
         ):
             binned = dtrain.get_binned(self._gbm.train_param.max_bin,

@@ -144,3 +144,102 @@ def distributed_grow_tree_lossguide(
         mesh, partial(grow_tree_lossguide, cfg=cfg_dist, max_leaves=max_leaves),
         out_specs, (bins, grad, hess, cut_values, key), feature_weights,
     )
+
+
+def distributed_boost_rounds_scan(
+    mesh: Mesh,
+    obj,  # scan-safe objective (elementwise/rowwise gradient)
+    bins: jax.Array,  # [n_pad, F] row-sharded narrow-int bins
+    label: jax.Array,  # [n_pad] row-sharded (pad rows arbitrary)
+    weight: Optional[jax.Array],  # [n_pad] row-sharded or None
+    margin: jax.Array,  # [n_pad, K] row-sharded
+    iters: jax.Array,  # [R] int32 iteration numbers
+    cut_values: jax.Array,  # [F, B] replicated
+    eta: jax.Array,
+    gamma: jax.Array,
+    feature_weights: Optional[jax.Array],
+    seed_base: jax.Array,  # uint32
+    n: int,  # real (unpadded) global row count
+    cfg: GrowParams,
+):
+    """A chunk of boosting rounds over row shards as ONE program: the
+    ``lax.scan`` of (gradient -> fused tree -> margin update) runs inside a
+    single ``shard_map``, with the per-level histogram / root-total psums
+    inside ``grow_tree_fused`` (hist/histogram.h:201's collective). Returns
+    (sharded margin [n_pad, K], replicated stacked trees [R, K, ...]).
+
+    Gradients are computed per shard (scan-safe objectives are rowwise);
+    rows past ``n`` (padding) get their gradients masked to zero every
+    round — the fixed-shape analog of the reference's empty-worker
+    handling."""
+    from ..gbm.gbtree import _obj_fingerprint
+
+    return _dist_scan_impl(
+        bins, label, weight, margin, iters, cut_values, eta, gamma,
+        feature_weights, seed_base, mesh=mesh, obj=obj,
+        obj_fp=_obj_fingerprint(obj), cfg=cfg, n=n,
+    )
+
+
+@partial(jax.jit, static_argnames=("mesh", "obj", "obj_fp", "cfg", "n"))
+def _dist_scan_impl(bins, label, weight, margin, iters, cut_values, eta,
+                    gamma, feature_weights, seed_base, *, mesh, obj, obj_fp,
+                    cfg, n):
+    import dataclasses
+
+    import jax.numpy as jnp
+    import jax.tree_util as jtu
+
+    cfg_dist = dataclasses.replace(cfg, axis_name=ROW_AXIS)
+    D = mesh.devices.size
+    n_pad, K = margin.shape
+    rows_local = n_pad // D
+
+    def shard_fn(bins_s, label_s, weight_s, m_s, fw):
+        r = jax.lax.axis_index(ROW_AXIS)
+        valid = (r * rows_local
+                 + jax.lax.broadcasted_iota(jnp.int32, (rows_local, 1), 0)[:, 0]
+                 ) < n
+        validf = valid.astype(jnp.float32)
+
+        def body(m_loc, i):
+            m = m_loc[:, 0] if K == 1 else m_loc
+            g, h = obj.get_gradient(m, label_s, weight_s, i)
+            trees = []
+            for k in range(K):
+                gk = (g[:, k] if g.ndim == 2 else g) * validf
+                hk = (h[:, k] if h.ndim == 2 else h) * validf
+                seed = (seed_base + i.astype(jnp.uint32) * jnp.uint32(131)
+                        + jnp.uint32(k * 17)) & jnp.uint32(0x7FFFFFFF)
+                key = jax.random.PRNGKey(seed.astype(jnp.int32))
+                t = grow_tree_fused(bins_s, gk, hk, cut_values, key, eta,
+                                    gamma, cfg_dist, feature_weights=fw)
+                m_loc = m_loc.at[:, k].add(t.delta)
+                trees.append(t._replace(delta=jnp.zeros((0,), jnp.float32)))
+            return m_loc, jtu.tree_map(lambda *xs: jnp.stack(xs), *trees)
+
+        return jax.lax.scan(body, m_s, iters)
+
+    tree_specs = GrownTree(**{f: P() for f in GrownTree._fields})
+    in_specs = [P(ROW_AXIS, None), P(ROW_AXIS)]
+    args = [bins, label]
+    if weight is not None:
+        in_specs.append(P(ROW_AXIS))
+        args.append(weight)
+    else:
+        in_specs.append(None)
+        args.append(None)
+    in_specs.append(P(ROW_AXIS, None))
+    args.append(margin)
+    if feature_weights is not None:
+        in_specs.append(P())
+        args.append(feature_weights)
+    else:
+        in_specs.append(None)
+        args.append(None)
+    fn = jax.shard_map(
+        shard_fn, mesh=mesh, in_specs=tuple(in_specs),
+        out_specs=(P(ROW_AXIS, None), tree_specs),
+        check_vma=False,  # see _row_sharded_call
+    )
+    return fn(*args)
