@@ -145,3 +145,27 @@ def test_lossguide_batched_reaches_leaf_budget():
                     d, 1, verbose_eval=False)
     t = bst._gbm.model.trees[0]
     assert t.num_leaves == 100, t.num_leaves
+
+
+def test_lossguide_update_many_scan_matches_per_round():
+    """Lossguide chunks scan on device too (_scan_rounds_lossguide_impl):
+    same trees as per-round updates, incl. model save/load."""
+    rng = np.random.RandomState(0)
+    X = rng.randn(3000, 6).astype(np.float32)
+    y = (X.sum(1) > 0).astype(np.float32)
+    params = {"objective": "binary:logistic", "grow_policy": "lossguide",
+              "max_leaves": 15, "max_depth": 0, "eta": 0.4, "seed": 2,
+              "subsample": 0.8}
+    d1 = xgb.DMatrix(X, label=y)
+    b1 = xgb.Booster(params, [d1])
+    for i in range(5):
+        b1.update(d1, i)
+    d2 = xgb.DMatrix(X, label=y)
+    b2 = xgb.Booster(params, [d2])
+    b2.update_many(d2, 0, 5, chunk=3)
+    np.testing.assert_allclose(b1.predict(d1), b2.predict(d2),
+                               rtol=1e-5, atol=1e-6)
+    blob = b2.save_raw()
+    b3 = xgb.Booster(model_file=blob)
+    np.testing.assert_allclose(b3.predict(d2), b2.predict(d2),
+                               rtol=1e-5, atol=1e-6)

@@ -386,6 +386,13 @@ class GBTreeModel:
         return out
 
 
+def _mesh_active() -> bool:
+    from ..parallel.mesh import current_mesh
+
+    mesh = current_mesh()
+    return mesh is not None and mesh.devices.size > 1
+
+
 def _obj_fingerprint(obj) -> tuple:
     """Hashable snapshot of the scalar params an objective can read at
     trace time. Part of the scan's static jit key so mutating params via
@@ -440,6 +447,43 @@ def _scan_rounds_impl(binsf, label, weight, m_pad, iters, cut_vals, eta,
         return m_pad, stacked
 
     return jax.lax.scan(body, m_pad, iters)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("obj", "obj_fp", "cfg", "n_groups",
+                                    "max_leaves"))
+def _scan_rounds_lossguide_impl(bins, label, weight, m_cur, iters, cut_vals,
+                                eta, gamma, fw, seed_base, *, obj, obj_fp,
+                                cfg, n_groups, max_leaves):
+    """Lossguide variant of the multi-round scan: body = gradient ->
+    allocation-ordered growth (grow_tree_lossguide) -> on-device prune /
+    leaf values / delta (finalize_alloc) -> margin update. Per-row
+    positions are stripped from the stacked outputs (only the delta uses
+    them)."""
+    from ..tree.grow_lossguide import finalize_alloc, grow_tree_lossguide
+
+    K = n_groups
+
+    def body(m_cur, i):
+        m = m_cur[:, 0] if K == 1 else m_cur
+        g, h = obj.get_gradient(m, label, weight, i)
+        outs = []
+        for k in range(K):
+            gk = g[:, k] if g.ndim == 2 else g
+            hk = h[:, k] if h.ndim == 2 else h
+            seed = (seed_base + i.astype(jnp.uint32) * jnp.uint32(131)
+                    + jnp.uint32(k * 17)) & jnp.uint32(0x7FFFFFFF)
+            key = jax.random.PRNGKey(seed.astype(jnp.int32))
+            alloc = grow_tree_lossguide(bins, gk, hk, cut_vals, key, cfg,
+                                        max_leaves, fw)
+            keep, lv, delta = finalize_alloc(alloc, eta, gamma)
+            m_cur = m_cur.at[:, k].add(delta)
+            outs.append((alloc._replace(
+                positions=jnp.zeros((0,), jnp.int32)), keep, lv))
+        stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *outs)
+        return m_cur, stacked
+
+    return jax.lax.scan(body, m_cur, iters)
 
 
 @BOOSTERS.register("gbtree")
@@ -566,6 +610,18 @@ class GBTree:
             or "grow_histmaker" in getattr(self, "_updater_seq", [])
         )
 
+    def _lossguide_max_leaves(self) -> int:
+        """Default leaf budget: bounded by depth when small, else a fixed
+        255 cap — the fixed-shape grower sizes its tensors and loop trips
+        by this, so it must stay modest (users wanting more set max_leaves
+        explicitly, as the reference requires for lossguide)."""
+        tp = self.train_param
+        if tp.max_leaves:
+            return tp.max_leaves
+        if 0 < tp.max_depth <= 8:
+            return 1 << tp.max_depth
+        return 255
+
     def _grow_params(self, axis_name: Optional[str] = None) -> GrowParams:
         tp = self.train_param
         return GrowParams(
@@ -646,16 +702,7 @@ class GBTree:
         cut_vals = jnp.asarray(cuts.values)
         lossguide = tp.grow_policy == "lossguide"
         if lossguide:
-            # default leaf budget: bounded by depth when small, else a fixed
-            # 255 cap — the fixed-shape grower sizes its tensors and loop
-            # trips by this, so it must stay modest (users wanting more set
-            # max_leaves explicitly, as the reference requires for lossguide)
-            if tp.max_leaves:
-                max_leaves = tp.max_leaves
-            elif 0 < tp.max_depth <= 8:
-                max_leaves = 1 << tp.max_depth
-            else:
-                max_leaves = 255
+            max_leaves = self._lossguide_max_leaves()
         new_trees: List[RegTree] = []
         if use_mesh:
             from ..parallel.grow import (
@@ -916,9 +963,9 @@ class GBTree:
             and self.gbtree_param.num_parallel_tree == 1
             and not self._is_update_process
             and getattr(obj, "scan_safe", False)
-            and tp.grow_policy != "lossguide"
             and not tuple(getattr(binned, "categorical", ()))
             and not getattr(binned, "is_paged", False)
+            and (tp.grow_policy != "lossguide" or not _mesh_active())
         )
 
     def boost_rounds_scan(
@@ -949,6 +996,11 @@ class GBTree:
         mesh = current_mesh()
         use_mesh = mesh is not None and mesh.devices.size > 1
         n = binned.n_rows
+        if tp.grow_policy == "lossguide":
+            assert not use_mesh  # eligibility gate keeps mesh off this path
+            return self._scan_lossguide(binned, obj, label, weight, margin,
+                                        start_iteration, num_rounds,
+                                        feature_weights)
         if use_mesh:
             binsf, n_pad = binned.fused_bins_mesh(mesh)
         else:
@@ -996,6 +1048,40 @@ class GBTree:
                     lambda a, r=r, k=k: a[r, k], stacked)
                 self.model.add_device(grown, tp.eta, k, tp.max_depth)
         return m_pad[:n]
+
+    def _scan_lossguide(self, binned, obj, label, weight, margin,
+                        start_iteration, num_rounds, feature_weights):
+        tp = self.train_param
+        cfg = self._grow_params()
+        max_leaves = self._lossguide_max_leaves()
+        K = self.n_groups
+        cut_vals = jnp.asarray(binned.cuts.values)
+        fw = (jnp.asarray(feature_weights)
+              if feature_weights is not None else None)
+        label_j = jnp.asarray(label, jnp.float32)
+        weight_j = (jnp.asarray(weight, jnp.float32)
+                    if weight is not None else None)
+        seed_base = np.uint32((tp.seed * 1000003) & 0xFFFFFFFF)
+        iters = jnp.arange(start_iteration, start_iteration + num_rounds,
+                           dtype=jnp.int32)
+        m_cur, stacked = _scan_rounds_lossguide_impl(
+            binned.bins, label_j, weight_j, margin, iters, cut_vals,
+            jnp.float32(tp.eta), jnp.float32(tp.gamma), fw,
+            jnp.uint32(seed_base), obj=obj, obj_fp=_obj_fingerprint(obj),
+            cfg=cfg, n_groups=K, max_leaves=max_leaves,
+        )
+        cat_mask = None
+        for r in range(num_rounds):
+            for k in range(K):
+                alloc = jax.tree_util.tree_map(
+                    lambda a, r=r, k=k: a[r, k], stacked[0])
+                keep = stacked[1][r, k]
+                lv = stacked[2][r, k]
+                self.model.add_device_alloc(
+                    alloc, keep, lv, tp.eta, tp.gamma, k, tp.max_depth,
+                    cat_mask,
+                )
+        return m_cur
 
     # ------------------------------------------------------------------
     def training_margin(self, X, base_margin: jax.Array) -> jax.Array:
