@@ -72,11 +72,29 @@ def _train_measured(xgb, X, y, params, rounds, budget_s, chunk=25,
     n_train = int(len(X) * (1 - test_size))
     dtrain = xgb.DMatrix(X[:n_train], label=y[:n_train])
 
+    scan_ok = True
+
+    def _chunk(b, lo, k):
+        """One chunk: the update_many scan, falling back (stickily) to
+        per-round updates if the scanned program fails on this backend."""
+        nonlocal scan_ok
+        if scan_ok:
+            try:
+                b.update_many(dtrain, lo, k, chunk=k)
+                return
+            except Exception as e:
+                scan_ok = False
+                print(f"# update_many failed ({type(e).__name__}: {e}); "
+                      "falling back to per-round updates",
+                      file=sys.stderr, flush=True)
+        for i in range(lo, lo + k):
+            b.update(dtrain, i)
+
     t0 = time.perf_counter()
     warm = xgb.Booster(params, [dtrain])
     # warm up THE SAME program the measured loop runs (a chunk-sized
     # update_many scan), so its compile stays out of measured_seconds
-    warm.update_many(dtrain, 0, min(chunk, rounds), chunk=chunk)
+    _chunk(warm, 0, min(chunk, rounds))
     _drain(warm, dtrain)
     print(f"# warmup (binning+compile+{min(chunk, rounds)} rounds): "
           f"{time.perf_counter()-t0:.1f}s", file=sys.stderr, flush=True)
@@ -88,8 +106,7 @@ def _train_measured(xgb, X, y, params, rounds, budget_s, chunk=25,
     while done < rounds:
         k = min(chunk, rounds - done)
         t0 = time.perf_counter()
-        # one scan dispatch per chunk when eligible (falls back per-round)
-        bst.update_many(dtrain, done, k, chunk=k)
+        _chunk(bst, done, k)
         _drain(bst, dtrain)
         measured += time.perf_counter() - t0
         done += k
@@ -142,13 +159,15 @@ def main() -> None:
     ap.add_argument("--chunk", type=int, default=25)
     args = ap.parse_args()
 
-    # persistent compilation cache: later runs (and the driver's) skip the
-    # multi-minute XLA/Mosaic compiles
-    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
     import jax
 
-    jax.config.update("jax_compilation_cache_dir",
-                      os.environ["JAX_COMPILATION_CACHE_DIR"])
+    if jax.default_backend() == "tpu":
+        # persistent compilation cache: later runs (and the driver's) skip
+        # the multi-minute XLA/Mosaic compiles. TPU-only: XLA:CPU's AOT
+        # cache reload is machine-feature-sensitive (observed SIGSEGV).
+        os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
+        jax.config.update("jax_compilation_cache_dir",
+                          os.environ["JAX_COMPILATION_CACHE_DIR"])
     import xgboost_tpu as xgb
 
     def params_for(max_bin):
