@@ -1,0 +1,29 @@
+"""The benchmark harness contract: bench.py must print exactly one JSON
+line with the driver's schema on ANY build (reference harness analog:
+tests/benchmark/benchmark_tree.py)."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_produces_json_line():
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, "bench.py", "--rows", "20000", "--iterations", "8",
+         "--smoke_rows", "4000", "--budget", "120", "--chunk", "4",
+         "--tuned_max_bin", "32"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=900,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [ln for ln in out.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, out.stdout
+    rec = json.loads(lines[0])
+    assert set(rec) == {"metric", "value", "unit", "vs_baseline"}
+    assert rec["unit"] == "s" and rec["value"] > 0
+    assert rec["metric"].startswith("train_time_20kx50_8r_depth6")
