@@ -89,3 +89,59 @@ def test_num_parallel_tree_survives_json_round_trip():
     b2 = xgb.Booster(model_file="/tmp/npt.json")
     assert b2.num_boosted_rounds() == 4
     assert b2[1:3]._gbm.model.num_trees == 6
+
+
+def test_loads_reference_written_model_json(tmp_path):
+    """Interop: a model file exactly as xgboost 1.6 writes it (doc/
+    model.schema: string-encoded scalars like base_score '5E-1',
+    num_class '0', int default_left flags, SoA tree arrays, INT_MAX root
+    parent) must load and predict correctly, missing -> default-left."""
+    import json
+    import math
+
+    model = {
+        "version": [1, 6, 0],
+        "learner": {
+            "attributes": {},
+            "feature_names": [],
+            "feature_types": [],
+            "gradient_booster": {
+                "model": {
+                    "gbtree_model_param": {"num_trees": "1",
+                                           "size_leaf_vector": "0"},
+                    "tree_info": [0],
+                    "trees": [{
+                        "base_weights": [0.0, -1.0, 2.0],
+                        "categories": [], "categories_nodes": [],
+                        "categories_segments": [], "categories_sizes": [],
+                        "default_left": [1, 0, 0],
+                        "id": 0,
+                        "left_children": [1, -1, -1],
+                        "loss_changes": [10.0, 0.0, 0.0],
+                        "parents": [2147483647, 0, 0],
+                        "right_children": [2, -1, -1],
+                        "split_conditions": [0.5, -1.0, 2.0],
+                        "split_indices": [0, 0, 0],
+                        "split_type": [0, 0, 0],
+                        "sum_hessian": [8.0, 4.0, 4.0],
+                        "tree_param": {"num_deleted": "0",
+                                       "num_feature": "1",
+                                       "num_nodes": "3",
+                                       "size_leaf_vector": "0"},
+                    }],
+                },
+                "name": "gbtree",
+            },
+            "learner_model_param": {"base_score": "5E-1", "num_class": "0",
+                                    "num_feature": "1"},
+            "objective": {"name": "binary:logistic",
+                          "reg_loss_param": {"scale_pos_weight": "1"}},
+        },
+    }
+    path = tmp_path / "ref_model.json"
+    path.write_text(json.dumps(model))
+    bst = xgb.Booster(model_file=str(path))
+    X = np.array([[0.3], [0.7], [np.nan]], np.float32)
+    p = bst.predict(xgb.DMatrix(X))
+    exp = [1 / (1 + math.exp(-v)) for v in (-1.0, 2.0, -1.0)]
+    np.testing.assert_allclose(p, exp, rtol=1e-6)
