@@ -507,7 +507,14 @@ class Booster:
     # ------------------------------------------------------------------
     def save_json(self) -> dict:
         self._configure()
+        fn = ft = []
+        for d in self._cache_refs.values():
+            fn = d.info.feature_names or []
+            ft = d.info.feature_types or []
+            break
         learner = {
+            "feature_names": list(fn),
+            "feature_types": list(ft),
             "learner_model_param": {
                 "base_score": str(
                     self.lparam.base_score
