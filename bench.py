@@ -67,55 +67,57 @@ def _train_measured(xgb, X, y, params, rounds, budget_s, chunk=25,
                     test_size=0.25, eval_rows=25_000):
     """Train up to `rounds` in timed chunks under `budget_s` of wall clock.
     Returns (rounds_done, measured_seconds, auc). Compile time is excluded
-    from measured_seconds via a 1-round warmup booster, matching how the
-    reference's table times training only."""
+    from measured_seconds via a warmup booster running the same chunk-sized
+    update_many scan as the measured loop, matching how the reference's
+    table times training only. If the scanned program fails anywhere
+    (dispatch OR at the drain's value readback), the whole measurement
+    restarts once from a fresh booster with per-round updates — the model
+    state after a mid-chunk failure is not trustworthy, so no partial
+    reuse."""
     n_train = int(len(X) * (1 - test_size))
     dtrain = xgb.DMatrix(X[:n_train], label=y[:n_train])
 
-    scan_ok = True
-
-    def _chunk(b, lo, k):
-        """One chunk: the update_many scan, falling back (stickily) to
-        per-round updates if the scanned program fails on this backend."""
-        nonlocal scan_ok
-        if scan_ok:
-            try:
+    def _run(use_scan):
+        def _chunk(b, lo, k):
+            if use_scan:
                 b.update_many(dtrain, lo, k, chunk=k)
-                return
-            except Exception as e:
-                scan_ok = False
-                print(f"# update_many failed ({type(e).__name__}: {e}); "
-                      "falling back to per-round updates",
-                      file=sys.stderr, flush=True)
-        for i in range(lo, lo + k):
-            b.update(dtrain, i)
+            else:
+                for i in range(lo, lo + k):
+                    b.update(dtrain, i)
 
-    t0 = time.perf_counter()
-    warm = xgb.Booster(params, [dtrain])
-    # warm up THE SAME program the measured loop runs (a chunk-sized
-    # update_many scan), so its compile stays out of measured_seconds
-    _chunk(warm, 0, min(chunk, rounds))
-    _drain(warm, dtrain)
-    print(f"# warmup (binning+compile+{min(chunk, rounds)} rounds): "
-          f"{time.perf_counter()-t0:.1f}s", file=sys.stderr, flush=True)
-    del warm
-
-    bst = xgb.Booster(params, [dtrain])
-    done = 0
-    measured = 0.0
-    while done < rounds:
-        k = min(chunk, rounds - done)
         t0 = time.perf_counter()
-        _chunk(bst, done, k)
-        _drain(bst, dtrain)
-        measured += time.perf_counter() - t0
-        done += k
-        print(f"# {done}/{rounds} rounds, {measured:.1f}s "
-              f"({done / measured:.1f} r/s)", file=sys.stderr, flush=True)
-        if measured > budget_s and done < rounds:
-            print(f"# wall-clock budget {budget_s}s hit at {done} rounds",
-                  file=sys.stderr, flush=True)
-            break
+        warm = xgb.Booster(params, [dtrain])
+        _chunk(warm, 0, min(chunk, rounds))
+        _drain(warm, dtrain)
+        print(f"# warmup (binning+compile+{min(chunk, rounds)} rounds): "
+              f"{time.perf_counter()-t0:.1f}s", file=sys.stderr, flush=True)
+        del warm
+
+        bst = xgb.Booster(params, [dtrain])
+        done = 0
+        measured = 0.0
+        while done < rounds:
+            k = min(chunk, rounds - done)
+            t0 = time.perf_counter()
+            _chunk(bst, done, k)
+            _drain(bst, dtrain)
+            measured += time.perf_counter() - t0
+            done += k
+            print(f"# {done}/{rounds} rounds, {measured:.1f}s "
+                  f"({done / measured:.1f} r/s)", file=sys.stderr, flush=True)
+            if measured > budget_s and done < rounds:
+                print(f"# wall-clock budget {budget_s}s hit at {done} "
+                      "rounds", file=sys.stderr, flush=True)
+                break
+        return bst, done, measured
+
+    try:
+        bst, done, measured = _run(use_scan=True)
+    except Exception as e:
+        print(f"# scanned training failed ({type(e).__name__}: {e}); "
+              "restarting with per-round updates", file=sys.stderr,
+              flush=True)
+        bst, done, measured = _run(use_scan=False)
 
     # quality gate on a held-out subset (kept modest so a slow predictor
     # can't eat the budget). A predict failure must NEVER discard the

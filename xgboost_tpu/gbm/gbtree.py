@@ -386,6 +386,23 @@ class GBTreeModel:
         return out
 
 
+def round_seed_py(seed: int, iteration: int, k: int = 0,
+                  ptree: int = 0) -> int:
+    """Per-tree RNG seed (python-int path). The traced twin
+    ``round_seed_traced`` MUST stay in lockstep — the scan paths' identity
+    with per-round training depends on it."""
+    return (seed * 1000003 + iteration * 131 + k * 17 + ptree) & 0x7FFFFFFF
+
+
+def round_seed_traced(seed_base_u32, i, k: int = 0):
+    """Traced twin of ``round_seed_py`` for scan bodies: ``seed_base_u32``
+    is uint32((seed * 1000003) & 0xFFFFFFFF); the 31-bit mask reads only
+    low bits, which uint32 arithmetic preserves, so the two formulas agree
+    bit for bit."""
+    return (seed_base_u32 + i.astype(jnp.uint32) * jnp.uint32(131)
+            + jnp.uint32(k * 17)) & jnp.uint32(0x7FFFFFFF)
+
+
 def _mesh_active() -> bool:
     from ..parallel.mesh import current_mesh
 
@@ -436,8 +453,7 @@ def _scan_rounds_impl(binsf, label, weight, m_pad, iters, cut_vals, eta,
             hk = pad0(h[:, k] if h.ndim == 2 else h)
             # bit-identical to boost_one_round's python-int key formula:
             # the 31-bit mask reads only low bits, which uint32 keeps
-            seed = (seed_base + i.astype(jnp.uint32) * jnp.uint32(131)
-                    + jnp.uint32(k * 17)) & jnp.uint32(0x7FFFFFFF)
+            seed = round_seed_traced(seed_base, i, k)
             key = jax.random.PRNGKey(seed.astype(jnp.int32))
             t = grow_tree_fused(binsf, gk, hk, cut_vals, key, eta, gamma,
                                 cfg, feature_weights=fw)
@@ -471,8 +487,7 @@ def _scan_rounds_lossguide_impl(bins, label, weight, m_cur, iters, cut_vals,
         for k in range(K):
             gk = g[:, k] if g.ndim == 2 else g
             hk = h[:, k] if h.ndim == 2 else h
-            seed = (seed_base + i.astype(jnp.uint32) * jnp.uint32(131)
-                    + jnp.uint32(k * 17)) & jnp.uint32(0x7FFFFFFF)
+            seed = round_seed_traced(seed_base, i, k)
             key = jax.random.PRNGKey(seed.astype(jnp.int32))
             alloc = grow_tree_lossguide(bins, gk, hk, cut_vals, key, cfg,
                                         max_leaves, fw)
@@ -728,7 +743,7 @@ class GBTree:
                 g, h = _shard_gh(g), _shard_gh(h)
             for ptree in range(self.gbtree_param.num_parallel_tree):
                 key = jax.random.PRNGKey(
-                    (tp.seed * 1000003 + iteration * 131 + k * 17 + ptree) & 0x7FFFFFFF
+                    round_seed_py(tp.seed, iteration, k, ptree)
                 )
                 fw = (
                     jnp.asarray(feature_weights)
@@ -939,8 +954,7 @@ class GBTree:
             h = hess[:, k] if hess.ndim == 2 else hess
             for ptree in range(self.gbtree_param.num_parallel_tree):
                 key = jax.random.PRNGKey(
-                    (tp.seed * 1000003 + iteration * 131 + k * 17 + ptree)
-                    & 0x7FFFFFFF
+                    round_seed_py(tp.seed, iteration, k, ptree)
                 )
                 grown = grow_one(g, h, key)
                 self.model.add_device(grown, tp.eta, k, tp.max_depth)
@@ -1019,16 +1033,18 @@ class GBTree:
         if n_pad != n:
             m_pad = jnp.concatenate(
                 [m_pad, jnp.zeros((n_pad - n, K), jnp.float32)])
-            label = jnp.concatenate(
-                [label, jnp.zeros((n_pad - n,), jnp.float32)])
-            if weight_j is not None:
-                weight_j = jnp.concatenate(
-                    [weight_j, jnp.zeros((n_pad - n,), jnp.float32)])
         iters = jnp.arange(start_iteration, start_iteration + num_rounds,
                            dtype=jnp.int32)
         if use_mesh:
             from ..parallel.grow import distributed_boost_rounds_scan
 
+            # the mesh path shards label/weight alongside the padded rows
+            if n_pad != n:
+                label = jnp.concatenate(
+                    [label, jnp.zeros((n_pad - n,), jnp.float32)])
+                if weight_j is not None:
+                    weight_j = jnp.concatenate(
+                        [weight_j, jnp.zeros((n_pad - n,), jnp.float32)])
             m_pad, stacked = distributed_boost_rounds_scan(
                 mesh, obj, binsf, shard_rows(label, mesh),
                 shard_rows(weight_j, mesh) if weight_j is not None else None,
@@ -1037,10 +1053,10 @@ class GBTree:
             )
         else:
             m_pad, stacked = _scan_rounds_impl(
-                binsf, label[:n], weight_j[:n] if weight_j is not None else None,
-                m_pad, iters, cut_vals, eta, gamma, fw,
-                jnp.uint32(seed_base), obj=obj, obj_fp=_obj_fingerprint(obj),
-                cfg=cfg, n=n, n_pad=n_pad, n_groups=K,
+                binsf, label, weight_j, m_pad, iters, cut_vals, eta, gamma,
+                fw, jnp.uint32(seed_base), obj=obj,
+                obj_fp=_obj_fingerprint(obj), cfg=cfg, n=n, n_pad=n_pad,
+                n_groups=K,
             )
         for r in range(num_rounds):
             for k in range(K):

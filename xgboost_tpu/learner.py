@@ -507,10 +507,14 @@ class Booster:
     # ------------------------------------------------------------------
     def save_json(self) -> dict:
         self._configure()
-        fn = ft = []
+        # feature metadata: live training data wins, else whatever a loaded
+        # model carried (so load -> save preserves names, like reference
+        # LearnerIO)
+        fn = list(getattr(self, "_loaded_feature_names", []) or [])
+        ft = list(getattr(self, "_loaded_feature_types", []) or [])
         for d in self._cache_refs.values():
-            fn = d.info.feature_names or []
-            ft = d.info.feature_types or []
+            fn = d.info.feature_names or fn
+            ft = d.info.feature_types or ft
             break
         learner = {
             "feature_names": list(fn),
@@ -565,6 +569,8 @@ class Booster:
             self._configure()
         self._gbm.load_json(gb)
         self.attributes_ = dict(learner.get("attributes", {}))
+        self._loaded_feature_names = list(learner.get("feature_names", []))
+        self._loaded_feature_types = list(learner.get("feature_types", []))
         self._caches.clear()
 
     def load_model(self, fname: Union[str, bytes, os.PathLike]) -> None:

@@ -190,6 +190,8 @@ def _dist_scan_impl(bins, label, weight, margin, iters, cut_values, eta,
     import jax.numpy as jnp
     import jax.tree_util as jtu
 
+    from ..gbm.gbtree import round_seed_traced
+
     cfg_dist = dataclasses.replace(cfg, axis_name=ROW_AXIS)
     D = mesh.devices.size
     n_pad, K = margin.shape
@@ -209,8 +211,7 @@ def _dist_scan_impl(bins, label, weight, margin, iters, cut_values, eta,
             for k in range(K):
                 gk = (g[:, k] if g.ndim == 2 else g) * validf
                 hk = (h[:, k] if h.ndim == 2 else h) * validf
-                seed = (seed_base + i.astype(jnp.uint32) * jnp.uint32(131)
-                        + jnp.uint32(k * 17)) & jnp.uint32(0x7FFFFFFF)
+                seed = round_seed_traced(seed_base, i, k)
                 key = jax.random.PRNGKey(seed.astype(jnp.int32))
                 t = grow_tree_fused(bins_s, gk, hk, cut_values, key, eta,
                                     gamma, cfg_dist, feature_weights=fw)
