@@ -681,9 +681,9 @@ class Booster:
                 weight[f] = weight.get(f, 0.0) + 1.0
                 gain[f] = gain.get(f, 0.0) + float(g)
                 cover[f] = cover.get(f, 0.0) + float(c)
-        names = None
+        names = list(getattr(self, "_loaded_feature_names", []) or []) or None
         for d in self._cache_refs.values():
-            names = d.feature_names
+            names = d.feature_names or names
             break
 
         def nm(f: int) -> str:
