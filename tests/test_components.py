@@ -587,3 +587,19 @@ def test_update_many_scan_matches_per_round_updates():
                       "max_depth": 3}, [db])
     bb.update_many(db, 0, 3)
     assert bb.num_boosted_rounds() == 3
+
+
+def test_get_split_value_histogram():
+    rng = np.random.RandomState(0)
+    X = rng.randn(2000, 4).astype(np.float32)
+    y = (X[:, 1] > 0.3).astype(np.float32)
+    d = xgb.DMatrix(X, label=y, feature_names=["a", "b", "c", "dd"])
+    bst = xgb.train({"objective": "binary:logistic", "max_depth": 3}, d, 5,
+                    verbose_eval=False)
+    h = bst.get_split_value_histogram("b", as_pandas=False)
+    assert h.shape[1] == 2 and h[:, 1].sum() > 0
+    # splits concentrate near the true threshold 0.3
+    top = h[np.argmax(h[:, 1]), 0]
+    assert abs(top - 0.3) < 0.5
+    with pytest.raises(ValueError, match="unknown feature"):
+        bst.get_split_value_histogram("nope")

@@ -638,6 +638,52 @@ class Booster:
     def attributes(self) -> Dict[str, str]:
         return dict(self.attributes_)
 
+    def get_split_value_histogram(self, feature: str, fmap: str = "",
+                                  bins: Optional[int] = None,
+                                  as_pandas: bool = True):
+        """Histogram of a feature's used split values (reference
+        ``core.py:2508`` — it regexes the text dump; here the SoA trees are
+        read directly). Categorical-split features raise like the
+        reference."""
+        self._configure()
+        names = list(getattr(self, "_loaded_feature_names", []) or [])
+        for d in self._cache_refs.values():
+            names = d.feature_names or names
+            break
+        try:
+            fidx = int(feature[1:]) if (not names and feature.startswith("f")
+                                        and feature[1:].isdigit()) \
+                else names.index(feature)
+        except (ValueError, AttributeError):
+            raise ValueError(f"unknown feature: {feature!r}")
+        values: List[float] = []
+        is_cat = False
+        for t in self._gbm.model.trees:
+            internal = t.left_children != -1
+            mask = internal & (t.split_indices == fidx)
+            if t.split_type is not None and bool(
+                    (np.asarray(t.split_type)[mask] != 0).any()):
+                is_cat = True
+                continue
+            values.extend(float(v) for v in t.split_conditions[mask])
+        if not values and is_cat:
+            raise ValueError(
+                "Split value historgam doesn't support categorical split."
+            )
+        n_unique = len(np.unique(values))
+        bins = max(min(n_unique, bins) if bins is not None else n_unique, 1)
+        nph = np.histogram(values, bins=bins)
+        nph = np.column_stack((nph[1][1:], nph[0]))
+        nph = nph[nph[:, 1] > 0]
+        if as_pandas:
+            try:
+                import pandas as pd
+
+                return pd.DataFrame(nph, columns=["SplitValue", "Count"])
+            except ImportError:
+                pass
+        return nph
+
     def get_dump(self, fmap: str = "", with_stats: bool = False, dump_format: str = "text") -> List[str]:
         self._configure()
         names = None
