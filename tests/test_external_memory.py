@@ -91,3 +91,47 @@ def test_native_pagecache_builds():
 
     lib = get_pagecache_lib()
     assert lib is not None, "native page cache failed to build"
+
+
+def test_paged_training_equals_streaming_at_scale():
+    """The paging machinery must be EXACT relative to the same streaming
+    sketch: an external-memory matrix and a StreamingQuantileDMatrix built
+    from the same iterator produce (near-)identical models — any
+    divergence would mean page-boundary or accumulation bugs, not sketch
+    approximation."""
+    import xgboost_tpu as xgb
+    from xgboost_tpu.data.external import ExternalMemoryQuantileDMatrix
+    from xgboost_tpu.data.iterator import DataIter, StreamingQuantileDMatrix
+
+    n, F, B = 100_000, 10, 5
+    rng = np.random.RandomState(0)
+    X = rng.randn(n, F).astype(np.float32)
+    w = rng.randn(F).astype(np.float32)
+    y = (X @ w + rng.randn(n) > 0).astype(np.float32)
+
+    def make_it():
+        class It(DataIter):
+            def __init__(self):
+                super().__init__()
+                self.i = 0
+
+            def reset(self):
+                self.i = 0
+
+            def next(self, input_data):
+                if self.i >= B:
+                    return 0
+                sl = slice(self.i * (n // B), (self.i + 1) * (n // B))
+                input_data(data=X[sl], label=y[sl])
+                self.i += 1
+                return 1
+        return It()
+
+    params = {"objective": "binary:logistic", "max_depth": 4, "max_bin": 32}
+    bext = xgb.train(params, ExternalMemoryQuantileDMatrix(make_it(), max_bin=32),
+                     5, verbose_eval=False)
+    bstr = xgb.train(params, StreamingQuantileDMatrix(make_it(), max_bin=32),
+                     5, verbose_eval=False)
+    probe = xgb.DMatrix(X[:20000])
+    np.testing.assert_allclose(bext.predict(probe), bstr.predict(probe),
+                               rtol=1e-4, atol=1e-5)
