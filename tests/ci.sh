@@ -12,7 +12,14 @@ export JAX_PLATFORMS=cpu
 unset PALLAS_AXON_POOL_IPS
 
 echo "=== tier 1: full suite (8-device virtual mesh) ==="
-python -m pytest tests/ -x -q
+# Two pytest processes, split alphabetically: a single process compiling
+# the whole suite's XLA:CPU programs occasionally segfaults inside
+# backend_compile_and_load (LLVM flake under heavy compile volume,
+# observed ~50% of single-process full runs; the crashing test varies and
+# every file passes in isolation). Halving the per-process compile load
+# sidesteps it and isolates any crash.
+python -m pytest tests/test_[a-e]*.py -x -q
+python -m pytest tests/test_[f-z]*.py -x -q
 
 echo "=== tier 2: debug_nans numeric core ==="
 JAX_DEBUG_NANS=1 python -m pytest tests/test_basic_train.py tests/test_fidelity.py -x -q

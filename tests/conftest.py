@@ -29,3 +29,9 @@ except Exception:  # backends already initialized; tests will use what exists
 # "+prefer-no-scatter not supported on the host machine" warnings followed
 # by a SIGSEGV inside backend_compile_and_load when reloading entries).
 # The TPU bench keeps its own cache (bench.py) where this path is safe.
+
+# NOTE on full-suite stability: running every test file in ONE process
+# occasionally segfaults inside XLA:CPU's backend_compile_and_load (LLVM
+# flake under the suite's compile volume; the crashing test varies, every
+# file passes in isolation, and ~half of single-process full runs are
+# clean). tests/ci.sh splits the suite into two processes to sidestep it.
