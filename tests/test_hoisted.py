@@ -1,0 +1,166 @@
+"""Hoisted-one-hot level kernel: layout + math equivalence on CPU.
+
+The Mosaic kernel itself only compiles on TPU hardware; these tests pin
+down everything around it — the [n, F*B] int8 layout contract of
+``build_onehot``, the exact hi/lo-bf16 contraction the kernel performs
+(emulated in XLA), and the [2K, F*B] -> [F, 2K, B] reshape the dispatcher
+applies — against the segment-sum oracle ``fused_level_xla``. A TPU run
+then only has to validate that Mosaic executes the same program
+(docs/perf.md records that measurement).
+
+Reference analog: gpu_hist's histogram kernel tests
+(tests/cpp/tree/gpu_hist/test_histogram.cu) compare the device kernel to a
+host-side oracle the same way.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from xgboost_tpu.tree.hist_kernel import (
+    build_onehot,
+    fused_level_xla,
+    hoist_budget_bytes,
+)
+
+_MASK_HI = np.int32(np.uint32(0xFFFF0000).view(np.int32))
+
+
+def _split_hilo_xla(x):
+    hi = jax.lax.bitcast_convert_type(
+        jax.lax.bitcast_convert_type(x, jnp.int32) & _MASK_HI, jnp.float32)
+    return hi, x - hi
+
+
+def _hoisted_emulated(bins, pos, gh, onehot, *, K, B, d):
+    """Pure-XLA twin of ``_hoisted_kernel``'s histogram half (post-
+    partition): same grad-channel layout, same bf16 operands, same
+    [2K, F*B] -> [F, 2K, B] reshape."""
+    n, F = bins.shape
+    offset = (1 << d) - 1
+    local = pos[:, 0] - offset
+    ohseg = jax.nn.one_hot(jnp.where((local >= 0) & (local < K), local, K),
+                           K + 1, dtype=jnp.float32)[:, :K]
+    g, h = gh[:, 0:1], gh[:, 1:2]
+    g_hi, g_lo = _split_hilo_xla(g)
+    h_hi, h_lo = _split_hilo_xla(h)
+    ghs4 = jnp.concatenate(
+        [ohseg * g_hi, ohseg * h_hi, ohseg * g_lo, ohseg * h_lo], axis=1
+    ).astype(jnp.bfloat16)  # [n, 4K]
+    out = jax.lax.dot_general(
+        ghs4, onehot.astype(jnp.bfloat16), (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [4K, F*B]
+    hist2 = out[: 2 * K] + out[2 * K:]
+    return jnp.transpose(hist2.reshape(2 * K, F, B), (1, 0, 2))
+
+
+def _case(n=512, F=5, B=16, seed=0, missing_frac=0.1):
+    rng = np.random.RandomState(seed)
+    bins = rng.randint(0, B, size=(n, F)).astype(np.int32)
+    miss = rng.rand(n, F) < missing_frac
+    bins[miss] = B  # missing sentinel
+    gh = rng.randn(n, 2).astype(np.float32)
+    gh[:, 1] = np.abs(gh[:, 1])
+    return jnp.asarray(bins), jnp.asarray(gh)
+
+
+def test_build_onehot_layout():
+    bins, _ = _case(n=64, F=3, B=8)
+    oh = np.asarray(build_onehot(bins, B=8))
+    assert oh.dtype == np.int8 and oh.shape == (64, 24)
+    oh3 = oh.reshape(64, 3, 8)
+    b = np.asarray(bins)
+    for f in range(3):
+        expect = (b[:, f, None] == np.arange(8)[None, :])
+        np.testing.assert_array_equal(oh3[:, f, :], expect.astype(np.int8))
+    # missing rows (bin == B) are all-zero -> drop out of histograms
+    assert (oh3[b[:, 1] == 8, 1, :] == 0).all()
+
+
+@pytest.mark.parametrize("d,K", [(0, 1), (2, 4)])
+def test_hoisted_contraction_matches_segment_sum(d, K):
+    bins, gh = _case(n=768, F=6, B=32, seed=3)
+    n = bins.shape[0]
+    rng = np.random.RandomState(7)
+    offset = (1 << d) - 1
+    pos = jnp.asarray(
+        rng.randint(offset, offset + K, size=(n, 1)).astype(np.int32))
+    onehot = build_onehot(bins, B=32)
+    got = _hoisted_emulated(bins, pos, gh, onehot, K=K, B=32, d=d)
+    ptab = jnp.zeros((max(K >> 1, 1), 4), jnp.float32)  # Kp=0: no partition
+    _, want = fused_level_xla(bins, pos, gh, ptab, K=K, Kp=0, B=32, d=d)
+    # hi/lo bf16 two-term sums agree with exact f32 to ~2^-16 relative
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_hoisted_kernel_interpret_mode():
+    """Run the real pallas_call body in interpret mode (CPU): this
+    exercises ``_hoisted_kernel`` exactly as written (incl. the TPU bitcast
+    hi/lo split, which interprets fine) against the segment-sum oracle.
+    Hardware (Mosaic) validation happens in the bench session."""
+    from xgboost_tpu.tree import hist_kernel as hk
+    from jax.experimental import pallas as pl
+    import functools
+
+    bins, gh = _case(n=512, F=4, B=16, seed=5)
+    pos = jnp.zeros((512, 1), jnp.int32)
+    onehot = build_onehot(bins, B=16)
+    ptab = jnp.zeros((1, 4), jnp.float32)
+    kern = functools.partial(hk._hoisted_kernel, K=1, Kp=0, F=4, B=16,
+                             prev_offset=0, offset=0)
+    pos_new, hist2 = pl.pallas_call(
+        kern,
+        grid=(2,),
+        in_specs=[
+            pl.BlockSpec((256, 4), lambda c: (c, 0)),
+            pl.BlockSpec((256, 64), lambda c: (c, 0)),
+            pl.BlockSpec((256, 1), lambda c: (c, 0)),
+            pl.BlockSpec((256, 2), lambda c: (c, 0)),
+            pl.BlockSpec((1, 4), lambda c: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((256, 1), lambda c: (c, 0)),
+            pl.BlockSpec((2, 64), lambda c: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((512, 1), jnp.int32),
+            jax.ShapeDtypeStruct((2, 64), jnp.float32),
+        ],
+        interpret=True,
+    )(bins, onehot, pos, gh, ptab)
+    hist = jnp.transpose(hist2.reshape(2, 4, 16), (1, 0, 2))
+    _, want = fused_level_xla(bins, pos, gh, ptab, K=1, Kp=0, B=16, d=0)
+    np.testing.assert_allclose(np.asarray(hist), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_hoist_budget_env(monkeypatch):
+    from xgboost_tpu.tree.hist_kernel import can_hoist
+
+    monkeypatch.setenv("XGBTPU_HOIST_BUDGET_MB", "1")
+    assert hoist_budget_bytes() == 1024 * 1024
+    # on CPU use_pallas() is False -> never hoist regardless of budget
+    assert not can_hoist(1024, 4, 16)
+
+
+def test_hoist_gates_agree():
+    """The build gate must never accept a configuration the dispatch gate
+    would then reject at some level (that would pin GiBs of HBM for zero
+    streaming). Sweep the realistic grid and assert implication."""
+    from xgboost_tpu.tree.hist_kernel import _hoist_tr
+
+    for F in (10, 50, 100, 200):
+        for B in (16, 64, 128, 256):
+            for max_depth in (1, 4, 6, 8):
+                deepest = _hoist_tr(F * B, 1 << (max_depth - 1), F)
+                if deepest:
+                    # monotone: every shallower level must also fit
+                    for d in range(max_depth):
+                        assert _hoist_tr(F * B, 1 << d, F) > 0, (F, B, d)
+    # the headline configs stream at full depth; bin256 at F=50 does not
+    assert _hoist_tr(50 * 64, 32, 50) > 0
+    assert _hoist_tr(50 * 128, 32, 50) > 0
+    assert _hoist_tr(50 * 256, 32, 50) == 0

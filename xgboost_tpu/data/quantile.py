@@ -259,6 +259,9 @@ class BinnedMatrix:
     # all-missing + zero gradients => inert, same trick as ``sharded``)
     _fused: Optional[Tuple[jax.Array, int]] = None
     _fused_mesh: Optional[Tuple[int, jax.Array, int]] = None
+    # cached HBM-resident [n_pad, F*B] int8 one-hot for the hoisted level
+    # kernel (training-invariant; built once per fit — tree/hist_kernel.py)
+    _onehot: Optional[jax.Array] = None
 
     def fused_bins(self) -> Tuple[jax.Array, int]:
         """(bins padded to the kernel row tile, padded row count) for the
@@ -279,6 +282,24 @@ class BinnedMatrix:
                            self.cuts.missing_bin, self.bins.dtype)
             b = jnp.concatenate([b, pad])
         return b
+
+    def fused_onehot(self, max_depth: int = 6) -> Optional[jax.Array]:
+        """The hoisted [n_pad, F*B] int8 one-hot of the bin matrix, or None
+        when the pallas path is off, it would not fit the HBM budget, or
+        the streaming kernel could not use it at this depth
+        (tree/hist_kernel.py:can_hoist — the build and dispatch gates share
+        one VMEM model). Cached once built: the expansion is
+        training-invariant, so every tree of every round streams the same
+        resident array."""
+        from ..tree.hist_kernel import build_onehot, can_hoist
+
+        bins, n_pad = self.fused_bins()
+        B = self.cuts.max_bin
+        if not can_hoist(n_pad, self.n_features, B, max_depth):
+            return None
+        if self._onehot is None:
+            self._onehot = build_onehot(bins, B=B)
+        return self._onehot
 
     def fused_bins_mesh(self, mesh) -> Tuple[jax.Array, int]:
         """Row-sharded bins for the fused grower under a mesh: rows padded

@@ -430,8 +430,8 @@ def _obj_fingerprint(obj) -> tuple:
                    static_argnames=("obj", "obj_fp", "cfg", "n", "n_pad",
                                     "n_groups"))
 def _scan_rounds_impl(binsf, label, weight, m_pad, iters, cut_vals, eta,
-                      gamma, fw, seed_base, *, obj, obj_fp, cfg, n, n_pad,
-                      n_groups):
+                      gamma, fw, seed_base, onehot=None, *, obj, obj_fp,
+                      cfg, n, n_pad, n_groups):
     """Multi-round boosting as one program: scan body = gradient -> fused
     tree(s) -> margin update (one tree per output group, like DoBoost's
     per-group gradient slicing, gbtree.cc:219). Cache key includes the
@@ -456,7 +456,7 @@ def _scan_rounds_impl(binsf, label, weight, m_pad, iters, cut_vals, eta,
             seed = round_seed_traced(seed_base, i, k)
             key = jax.random.PRNGKey(seed.astype(jnp.int32))
             t = grow_tree_fused(binsf, gk, hk, cut_vals, key, eta, gamma,
-                                cfg, feature_weights=fw)
+                                cfg, feature_weights=fw, onehot=onehot)
             m_pad = m_pad.at[:, k].add(t.delta)
             trees.append(t._replace(delta=jnp.zeros((0,), jnp.float32)))
         stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *trees)
@@ -937,6 +937,7 @@ class GBTree:
                 )
         else:
             binsf, n_pad = binned.fused_bins()
+            onehot = binned.fused_onehot(tp.max_depth)
 
             def grow_one(g, h, key):
                 if n_pad != n:
@@ -945,7 +946,7 @@ class GBTree:
                     h = jnp.concatenate([h, pad])
                 return grow_tree_fused(
                     binsf, g, h, cut_vals, key,
-                    float(tp.eta), float(tp.gamma), cfg, fw,
+                    float(tp.eta), float(tp.gamma), cfg, fw, onehot,
                 )
 
         new_trees = []
@@ -1054,7 +1055,8 @@ class GBTree:
         else:
             m_pad, stacked = _scan_rounds_impl(
                 binsf, label, weight_j, m_pad, iters, cut_vals, eta, gamma,
-                fw, jnp.uint32(seed_base), obj=obj,
+                fw, jnp.uint32(seed_base), binned.fused_onehot(tp.max_depth),
+                obj=obj,
                 obj_fp=_obj_fingerprint(obj), cfg=cfg, n=n, n_pad=n_pad,
                 n_groups=K,
             )

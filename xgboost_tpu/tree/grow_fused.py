@@ -300,6 +300,7 @@ def grow_tree_fused(
     gamma: jax.Array,  # traced scalar (min_split_loss for pruning)
     cfg: GrowParams,
     feature_weights: Optional[jax.Array] = None,
+    onehot: Optional[jax.Array] = None,  # [n_pad, F*B] int8 (hoisted)
 ) -> GrownTree:
     bins = bins.astype(jnp.int32)  # transient in-program widening
     n, F = bins.shape
@@ -337,7 +338,8 @@ def grow_tree_fused(
         K = 1 << d
         Kp = K >> 1  # previous level width (0 at the root)
         pos, histC = fused_level(
-            bins, pos, gh, st.ptab, K=K, Kp=Kp, B=B, d=d, pallas=pallas
+            bins, pos, gh, st.ptab, K=K, Kp=Kp, B=B, d=d, pallas=pallas,
+            onehot=onehot,
         )  # histC: [F, 2K, B], missing excluded
         if cfg.axis_name is not None:
             histC = jax.lax.psum(histC, cfg.axis_name)

@@ -41,10 +41,11 @@ import numpy as np
 
 __all__ = [
     "fused_level", "fused_level_xla", "partition_apply_xla", "leaf_delta",
-    "TR", "use_pallas",
+    "TR", "use_pallas", "build_onehot", "hoist_budget_bytes", "can_hoist",
 ]
 
 TR = 1024  # rows per kernel grid step
+TR_HOIST = 512  # rows per grid step for the hoisted-one-hot kernel
 
 # 0xFFFF0000 as int32: masks an f32 down to its bf16-representable prefix
 _MASK_HI = np.int32(np.uint32(0xFFFF0000).view(np.int32))
@@ -59,6 +60,61 @@ def use_pallas() -> bool:
     return jax.default_backend() == "tpu"
 
 
+# ---------------------------------------------------------------------------
+# Hoisted one-hot: the quantized matrix's one-hot expansion is TRAINING-
+# INVARIANT, yet the in-kernel construction (n x F x B int32 compares on the
+# VPU) was measured as the per-level floor (~22 ms/level at 256 bins,
+# docs/perf.md) and is re-done 6 levels x 500 rounds a run. Precomputing it
+# ONCE per fit as an HBM-resident [n, F*B] int8 turns every level into pure
+# MXU streaming: the level cost drops to the HBM read of the one-hot
+# (~n*F*B bytes) overlapped with the matmuls. At max_bin=64 on the headline
+# 1M x 50 workload that is 3.2 GB resident / ~4 ms/level streamed vs the
+# ~22 ms construction floor. Reference analog: gpu_hist keeps the compressed
+# ELLPACK resident and re-reads it per level (gpu_hist/histogram.cu:127) —
+# this is the same trade with the TPU's preferred operand layout.
+# ---------------------------------------------------------------------------
+
+_HOIST_BUDGET_ENV = "XGBTPU_HOIST_BUDGET_MB"
+
+
+def hoist_budget_bytes() -> int:
+    """HBM budget for the resident one-hot (default 8 GiB on a 16 GiB v5e;
+    override with XGBTPU_HOIST_BUDGET_MB, 0 disables hoisting)."""
+    import os
+
+    try:
+        mb = int(os.environ.get(_HOIST_BUDGET_ENV, "8192"))
+    except ValueError:
+        mb = 8192
+    return mb * 1024 * 1024
+
+
+def can_hoist(n_pad: int, F: int, B: int, max_depth: int = 6) -> bool:
+    """Whether hoisting pays: the [n_pad, F*B] int8 one-hot fits the HBM
+    budget, the pallas path is live (the XLA fallback's segment_sum never
+    needs it), AND the streaming kernel's VMEM working set fits at EVERY
+    level of the configured depth (``_hoist_tr``, the same gate
+    ``fused_level`` applies) — otherwise a multi-GiB resident array would
+    be built that the dispatcher then never streams."""
+    if not (use_pallas() and n_pad * F * B <= hoist_budget_bytes()):
+        return False
+    deepest_K = 1 << max(max_depth - 1, 0)
+    return _hoist_tr(F * B, deepest_K, F) > 0
+
+
+@functools.partial(jax.jit, static_argnames=("B",))
+def build_onehot(bins: jax.Array, *, B: int) -> jax.Array:
+    """[n, F] narrow-int bins -> [n, F*B] int8 one-hot (missing bin ``B``
+    maps to an all-zero row, so missing rows drop out of histograms exactly
+    like the in-kernel construction). Built by XLA (which takes narrow
+    compares happily — it is Mosaic that rejects sub-32-bit iota), one time
+    per training run."""
+    n, F = bins.shape
+    iota = jnp.arange(B, dtype=jnp.int32)
+    oh = (bins.astype(jnp.int32)[:, :, None] == iota[None, None, :])
+    return oh.astype(jnp.int8).reshape(n, F * B)
+
+
 def _split_hilo(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
     """Exact f32 = hi + lo with both parts bf16-representable. Done with a
     bitcast mask (not a dtype round-trip) so XLA/Mosaic cannot fold
@@ -69,6 +125,53 @@ def _split_hilo(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
     return hi, x - hi
 
 
+def _partition_tile(pos, binsb, ptab_ref, *, Kp: int, F: int, B: int,
+                    prev_offset: int):
+    """Route a tile's rows through the previous level's decision table
+    (shared by both level kernels). ``pos``/``binsb`` are values in VMEM."""
+    Tr = binsb.shape[0]
+    lp = pos - prev_offset
+    iota_kp = jax.lax.broadcasted_iota(jnp.int32, (Tr, Kp), 1)
+    ohp = (lp == iota_kp).astype(jnp.float32)
+    # f32 table matmul: exact for feature ids / bin ids up to 2^24
+    dec = jax.lax.dot_general(
+        ohp, ptab_ref[:, :], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )  # [Tr, 4] = (is_split, feature, bin, default_left)
+    isp_of = dec[:, 0:1]
+    f_of = dec[:, 1:2].astype(jnp.int32)
+    b_of = dec[:, 2:3]
+    dl_of = dec[:, 3:4]
+    iota_f = jax.lax.broadcasted_iota(jnp.int32, (Tr, F), 1)
+    ohf = (f_of == iota_f).astype(jnp.float32)
+    bv = jnp.sum(ohf * binsb.astype(jnp.float32), axis=1, keepdims=True)
+    # arithmetic (not boolean) masks: Mosaic rejects i1 vectors at lane 1
+    missing = (bv >= B).astype(jnp.float32)
+    leq = (bv <= b_of).astype(jnp.float32)
+    goleft = missing * dl_of + (1.0 - missing) * leq
+    inb = (lp >= 0).astype(jnp.float32) * (lp < Kp).astype(jnp.float32)
+    goes = inb * isp_of
+    child = 2 * pos + 1 + (goleft < 0.5).astype(jnp.int32)
+    return pos + (goes > 0.5).astype(jnp.int32) * (child - pos)
+
+
+def _grad_channels(pos, gh_ref, *, K: int, offset: int):
+    """[Tr, 4K] bf16 per-node gradient channels from heap positions; column
+    order [g_hi | h_hi | g_lo | h_lo] so ``out[:2K] + out[2K:] = [g, h]``."""
+    Tr = pos.shape[0]
+    local = pos - offset
+    iota_k = jax.lax.broadcasted_iota(jnp.int32, (Tr, K), 1)
+    ohseg = (local == iota_k).astype(jnp.float32)  # [Tr, K]
+    g = gh_ref[:, 0:1]
+    h = gh_ref[:, 1:2]
+    g_hi, g_lo = _split_hilo(g)
+    h_hi, h_lo = _split_hilo(h)
+    return jnp.concatenate(
+        [ohseg * g_hi, ohseg * h_hi, ohseg * g_lo, ohseg * h_lo], axis=1
+    ).astype(jnp.bfloat16)  # [Tr, 4K]
+
+
 def _level_kernel(bins_ref, pos_ref, gh_ref, ptab_ref, pos_out, hist_ref,
                   *, K: int, Kp: int, F: int, B: int,
                   prev_offset: int, offset: int):
@@ -77,7 +180,6 @@ def _level_kernel(bins_ref, pos_ref, gh_ref, ptab_ref, pos_out, hist_ref,
     from jax.experimental import pallas as pl
 
     c = pl.program_id(0)
-    Tr = bins_ref.shape[0]
 
     @pl.when(c == 0)
     def _():
@@ -85,45 +187,14 @@ def _level_kernel(bins_ref, pos_ref, gh_ref, ptab_ref, pos_out, hist_ref,
 
     pos = pos_ref[:, :]  # [Tr, 1] i32 heap positions
     binsb = bins_ref[:, :]  # [Tr, F] i32
+    Tr = binsb.shape[0]
 
     if Kp > 0:
-        lp = pos - prev_offset
-        iota_kp = jax.lax.broadcasted_iota(jnp.int32, (Tr, Kp), 1)
-        ohp = (lp == iota_kp).astype(jnp.float32)
-        # f32 table matmul: exact for feature ids / bin ids up to 2^24
-        dec = jax.lax.dot_general(
-            ohp, ptab_ref[:, :], (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
-        )  # [Tr, 4] = (is_split, feature, bin, default_left)
-        isp_of = dec[:, 0:1]
-        f_of = dec[:, 1:2].astype(jnp.int32)
-        b_of = dec[:, 2:3]
-        dl_of = dec[:, 3:4]
-        iota_f = jax.lax.broadcasted_iota(jnp.int32, (Tr, F), 1)
-        ohf = (f_of == iota_f).astype(jnp.float32)
-        bv = jnp.sum(ohf * binsb.astype(jnp.float32), axis=1, keepdims=True)
-        # arithmetic (not boolean) masks: Mosaic rejects i1 vectors at lane 1
-        missing = (bv >= B).astype(jnp.float32)
-        leq = (bv <= b_of).astype(jnp.float32)
-        goleft = missing * dl_of + (1.0 - missing) * leq
-        inb = (lp >= 0).astype(jnp.float32) * (lp < Kp).astype(jnp.float32)
-        goes = inb * isp_of
-        child = 2 * pos + 1 + (goleft < 0.5).astype(jnp.int32)
-        pos = pos + (goes > 0.5).astype(jnp.int32) * (child - pos)
+        pos = _partition_tile(pos, binsb, ptab_ref, Kp=Kp, F=F, B=B,
+                              prev_offset=prev_offset)
     pos_out[:, :] = pos
 
-    local = pos - offset
-    iota_k = jax.lax.broadcasted_iota(jnp.int32, (Tr, K), 1)
-    ohseg = (local == iota_k).astype(jnp.float32)  # [Tr, K]
-    g = gh_ref[:, 0:1]
-    h = gh_ref[:, 1:2]
-    g_hi, g_lo = _split_hilo(g)
-    h_hi, h_lo = _split_hilo(h)
-    # column order [g_hi | h_hi | g_lo | h_lo]: out[:2K] + out[2K:] = [g, h]
-    ghs4 = jnp.concatenate(
-        [ohseg * g_hi, ohseg * h_hi, ohseg * g_lo, ohseg * h_lo], axis=1
-    ).astype(jnp.bfloat16)  # [Tr, 4K]
+    ghs4 = _grad_channels(pos, gh_ref, K=K, offset=offset)
 
     for f in range(F):
         col = binsb[:, f:f + 1]
@@ -171,6 +242,78 @@ def _fused_level_pallas(bins, pos, gh, ptab, *, K, Kp, B, d, tr=TR):
     )(bins, pos, gh, ptab)
 
 
+def _hoisted_kernel(bins_ref, oh_ref, pos_ref, gh_ref, ptab_ref, pos_out,
+                    hist_ref, *, K: int, Kp: int, F: int, B: int,
+                    prev_offset: int, offset: int):
+    """Hoisted-one-hot grid step: partition + grad channels (cheap VPU) and
+    ONE [4K, Tr] x [Tr, F*B] MXU matmul streaming the resident one-hot —
+    no in-kernel one-hot construction at all."""
+    from jax.experimental import pallas as pl
+
+    c = pl.program_id(0)
+
+    @pl.when(c == 0)
+    def _():
+        hist_ref[...] = jnp.zeros_like(hist_ref)
+
+    pos = pos_ref[:, :]
+    binsb = bins_ref[:, :]
+    if Kp > 0:
+        pos = _partition_tile(pos, binsb, ptab_ref, Kp=Kp, F=F, B=B,
+                              prev_offset=prev_offset)
+    pos_out[:, :] = pos
+
+    ghs4 = _grad_channels(pos, gh_ref, K=K, offset=offset)  # [Tr, 4K]
+    oh = oh_ref[:, :].astype(jnp.bfloat16)  # [Tr, F*B] int8 -> bf16
+    out = jax.lax.dot_general(
+        ghs4, oh, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [4K, F*B]
+    hist_ref[:, :] += out[: 2 * K] + out[2 * K:]
+
+
+@functools.partial(jax.jit, static_argnames=("K", "Kp", "B", "d", "tr"))
+def _hoisted_level_pallas(bins, onehot, pos, gh, ptab, *, K, Kp, B, d,
+                          tr=TR_HOIST):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n, F = bins.shape
+    Q = F * B
+    assert onehot.shape == (n, Q), (onehot.shape, (n, Q))
+    assert n % tr == 0, f"rows {n} not padded to {tr}"
+    prev_offset = (1 << (d - 1)) - 1 if d > 0 else 0
+    offset = (1 << d) - 1
+    kern = functools.partial(
+        _hoisted_kernel, K=K, Kp=Kp, F=F, B=B,
+        prev_offset=prev_offset, offset=offset,
+    )
+    pos_new, hist2 = pl.pallas_call(
+        kern,
+        grid=(n // tr,),
+        in_specs=[
+            pl.BlockSpec((tr, F), lambda c: (c, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((tr, Q), lambda c: (c, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((tr, 1), lambda c: (c, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((tr, 2), lambda c: (c, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((max(Kp, 1), 4), lambda c: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((tr, 1), lambda c: (c, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((2 * K, Q), lambda c: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, 1), jnp.int32),
+            jax.ShapeDtypeStruct((2 * K, Q), jnp.float32),
+        ],
+    )(bins, onehot, pos, gh, ptab)
+    # [2K, F*B] -> the dispatcher contract [F, 2K, B]
+    hist = jnp.transpose(hist2.reshape(2 * K, F, B), (1, 0, 2))
+    return pos_new, hist
+
+
 def partition_apply_xla(bins, pos, ptab, *, Kp: int, B: int, d: int):
     """Route rows through level ``d-1``'s decisions (XLA, gather-free where
     it matters: the per-node table lookup is a one-hot matmul)."""
@@ -216,13 +359,41 @@ def fused_level_xla(bins, pos, gh, ptab, *, K, Kp, B, d):
 
 
 _VMEM_ACC_BUDGET = 6 * 1024 * 1024  # bytes for the [F, 2K, B] accumulator
+_VMEM_HOIST_BUDGET = 12 * 1024 * 1024  # total working set of the hoisted step
 
 
-def fused_level(bins, pos, gh, ptab, *, K, Kp, B, d, pallas: bool):
+def _hoist_vmem_bytes(tr: int, Q: int, K: int, F: int) -> int:
+    """Working-set estimate for one hoisted grid step: double-buffered int8
+    one-hot tile + its bf16 cast + the [4K, Q] dot output + the [2K, Q] f32
+    accumulator + the bins tile."""
+    return 2 * tr * Q + 2 * tr * Q + 4 * K * Q * 4 + 2 * K * Q * 4 + tr * F * 4
+
+
+def _hoist_tr(Q: int, K: int, F: int) -> int:
+    """Largest workable row tile for the hoisted kernel at this level's
+    node count, or 0 if no tile fits VMEM. Single source of truth for both
+    the build-side gate (``can_hoist``) and the dispatch (``fused_level``)
+    so they cannot disagree."""
+    for tr in (TR_HOIST, TR_HOIST // 2):
+        if _hoist_vmem_bytes(tr, Q, K, F) <= _VMEM_HOIST_BUDGET:
+            return tr
+    return 0
+
+
+def fused_level(bins, pos, gh, ptab, *, K, Kp, B, d, pallas: bool,
+                onehot: Optional[jax.Array] = None):
     """Dispatch: (new pos [n,1] i32, hist [F, 2K, B] f32). ``hist`` excludes
-    the missing bin (derive per-feature missing sums as total - sum)."""
+    the missing bin (derive per-feature missing sums as total - sum).
+    ``onehot`` (the HBM-resident [n, F*B] int8 expansion) selects the
+    streaming kernel; deep levels whose accumulators outgrow VMEM fall back
+    to the in-kernel construction, then to XLA."""
     F = bins.shape[1]
     acc_bytes = F * 2 * K * B * 4
+    if pallas and onehot is not None:
+        tr = _hoist_tr(F * B, K, F)
+        if tr and bins.shape[0] % tr == 0:
+            return _hoisted_level_pallas(bins, onehot, pos, gh, ptab,
+                                         K=K, Kp=Kp, B=B, d=d, tr=tr)
     if pallas and F <= _MAX_KERNEL_FEATURES and acc_bytes <= _VMEM_ACC_BUDGET:
         return _fused_level_pallas(bins, pos, gh, ptab, K=K, Kp=Kp, B=B, d=d)
     return fused_level_xla(bins, pos, gh, ptab, K=K, Kp=Kp, B=B, d=d)
