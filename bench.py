@@ -21,14 +21,26 @@ same held-out split (|dAUC| <= 0.002); otherwise the default-config number
 is primary. Both timings and AUCs always go to stderr.
 
 Robustness (this harness must produce a number on ANY build, fast or slow):
+- the backend is probed in a SUBPROCESS with a timeout BEFORE this process
+  imports jax — a wedged TPU relay (which hangs at interpreter start /
+  first dispatch and wedged round 3's driver run) degrades to
+  JAX_PLATFORMS=cpu with the metric marked "_cpu_fallback" instead of
+  hanging or crashing;
 - a tiny smoke run compiles/executes the full pipeline first so backend
   problems surface in seconds;
 - each workload is measured INCREMENTALLY in chunks of rounds under a
   wall-clock budget. If the budget runs out, the JSON line still prints,
   with the 500-round time extrapolated from the measured rounds/s and the
   metric name marked "_extrapolated";
+- every completed chunk and config is appended to ``bench_partial.jsonl``
+  as it happens, and the final JSON line is emitted from whatever was
+  measured even when a later stage dies — a crash after the first config
+  can no longer lose its number (which is exactly what happened to round
+  3's 67.5s measurement);
 - row count halves on hard failure (OOM/backend error) until a measurement
-  succeeds, reporting the achieved size in the metric name.
+  succeeds, reporting the achieved size in the metric name;
+- if literally nothing could be measured, a schema-compatible JSON error
+  line is printed and the exit code is still 0.
 """
 
 from __future__ import annotations
@@ -36,12 +48,50 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
 import time
+import traceback
 
 import numpy as np
 
 BASELINE_HIST_SECONDS = 36.01  # reference doc/gpu/index.rst: 'hist' on Ryzen 7 2700
+
+PARTIAL_PATH = os.environ.get("XGBTPU_BENCH_PARTIAL",
+                              "bench_partial.jsonl")
+
+
+def _log_partial(rec: dict) -> None:
+    """Append a progress record to the sidecar file (best effort)."""
+    try:
+        with open(PARTIAL_PATH, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+    except OSError:
+        pass
+
+
+def _probe_backend(timeout_s: float = 240.0) -> str | None:
+    """Ask a SUBPROCESS what jax.default_backend() is, so a wedged TPU
+    relay (which hangs inside sitecustomize/backend init) can be detected
+    and killed without taking this process down. Two attempts; None means
+    the backend is unusable. The generous timeout matters: a healthy
+    relay claim takes ~10-30s, and killing a merely-slow claim can wedge
+    the pool (docs/perf.md) — only a truly stuck probe should expire."""
+    code = "import jax; print('BK=' + jax.default_backend())"
+    for attempt in (1, 2):
+        try:
+            r = subprocess.run([sys.executable, "-c", code],
+                               capture_output=True, text=True,
+                               timeout=timeout_s)
+            for ln in r.stdout.splitlines():
+                if ln.startswith("BK="):
+                    return ln[3:].strip()
+            print(f"# backend probe attempt {attempt}: rc={r.returncode} "
+                  f"{r.stderr[-300:]!r}", file=sys.stderr, flush=True)
+        except subprocess.TimeoutExpired:
+            print(f"# backend probe attempt {attempt}: timed out after "
+                  f"{timeout_s}s", file=sys.stderr, flush=True)
+    return None
 
 
 def _make_data(rows: int, cols: int, sparsity: float, seed: int = 42):
@@ -64,7 +114,7 @@ def _drain(bst, dtrain):
 
 
 def _train_measured(xgb, X, y, params, rounds, budget_s, chunk=25,
-                    test_size=0.25, eval_rows=25_000):
+                    test_size=0.25, eval_rows=25_000, on_chunk=None):
     """Train up to `rounds` in timed chunks under `budget_s` of wall clock.
     Returns (rounds_done, measured_seconds, auc). Compile time is excluded
     from measured_seconds via a warmup booster running the same chunk-sized
@@ -105,6 +155,8 @@ def _train_measured(xgb, X, y, params, rounds, budget_s, chunk=25,
             done += k
             print(f"# {done}/{rounds} rounds, {measured:.1f}s "
                   f"({done / measured:.1f} r/s)", file=sys.stderr, flush=True)
+            if on_chunk is not None:
+                on_chunk(done, measured)
             if measured > budget_s and done < rounds:
                 print(f"# wall-clock budget {budget_s}s hit at {done} "
                       "rounds", file=sys.stderr, flush=True)
@@ -143,6 +195,144 @@ def _train_measured(xgb, X, y, params, rounds, budget_s, chunk=25,
     return done, measured, auc
 
 
+def _run_configs(args, suffix: str, final: dict) -> None:
+    """The measurement body. Mutates ``final`` (the record the caller's
+    ``finally`` prints) after every completed stage so a crash at ANY later
+    point still reports the best completed measurement."""
+    import jax
+
+    try:
+        if jax.default_backend() == "tpu":
+            # persistent compilation cache: later runs (and the driver's)
+            # skip the multi-minute XLA/Mosaic compiles. TPU-only: XLA:CPU's
+            # AOT cache reload is machine-feature-sensitive (SIGSEGV).
+            os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                                  "/tmp/jax_cache")
+            jax.config.update("jax_compilation_cache_dir",
+                              os.environ["JAX_COMPILATION_CACHE_DIR"])
+    except Exception as e:  # never let cache setup kill the bench
+        print(f"# compile-cache setup skipped: {e}", file=sys.stderr,
+              flush=True)
+    import xgboost_tpu as xgb
+
+    def params_for(max_bin):
+        return {
+            "objective": "binary:logistic",
+            "tree_method": args.tree_method,
+            "max_depth": args.max_depth,
+            "max_bin": max_bin,
+            "eta": 0.1,
+            "verbosity": 1,
+        }
+
+    def set_final(rows, done, measured, bin_suffix):
+        """Fold a completed (possibly partial) measurement into the final
+        record; extrapolate when fewer than the full rounds ran."""
+        if done <= 0 or measured <= 0:
+            return
+        name = (f"train_time_{rows // 1000}kx{args.columns}_"
+                f"{args.iterations}r_depth{args.max_depth}{bin_suffix}"
+                f"{suffix}")
+        if done == args.iterations:
+            value = measured
+        else:
+            value = args.iterations * measured / done
+            name += f"_extrapolated_from_{done}r"
+        final.update({
+            "metric": name,
+            "value": round(value, 3),
+            "unit": "s",
+            "vs_baseline": round(BASELINE_HIST_SECONDS / value, 3),
+        })
+
+    # ---- smoke: whole pipeline on a tiny shape; failures surface fast ----
+    t0 = time.perf_counter()
+    smoke_rows = min(args.smoke_rows, args.rows)
+    Xs, ys = _make_data(smoke_rows, args.columns, args.sparsity, seed=7)
+    sd, ss, sauc = _train_measured(xgb, Xs, ys, params_for(args.max_bin),
+                                   rounds=3, budget_s=1e9, chunk=3)
+    print(f"# smoke {smoke_rows}x{args.columns} 3r: {ss:.2f}s auc={sauc:.3f} "
+          f"(total incl. compile {time.perf_counter() - t0:.1f}s)",
+          file=sys.stderr, flush=True)
+    if sauc != sauc:
+        raise SystemExit("smoke predict failed — predictor is broken")
+
+    # ---- headline workload, halving rows on hard failure ----
+    rows = args.rows
+
+    def on_chunk_default(done, measured):
+        _log_partial({"config": f"bin{args.max_bin}", "rows": rows,
+                      "rounds_done": done, "seconds": round(measured, 3)})
+        set_final(rows, done, measured, "")
+
+    while True:
+        try:
+            X, y = _make_data(rows, args.columns, args.sparsity)
+            done, measured, auc = _train_measured(
+                xgb, X, y, params_for(args.max_bin), args.iterations,
+                args.budget, args.chunk, on_chunk=on_chunk_default)
+            break
+        except Exception as e:  # OOM / backend error: shrink and retry
+            print(f"# {rows} rows failed: {type(e).__name__}: {e}",
+                  file=sys.stderr, flush=True)
+            # chunks completed before a HARD failure are not trustworthy
+            # (unlike a clean budget stop): discard them from the record
+            final.clear()
+            rows //= 2
+            if rows < 1000:
+                raise SystemExit("benchmark failed at every size")
+
+    rps = done / measured if measured > 0 else 0.0
+    print(f"# [max_bin={args.max_bin}] rounds/s: {rps:.2f}  test-auc: {auc:.4f}",
+          file=sys.stderr, flush=True)
+    _log_partial({"config": f"bin{args.max_bin}", "rows": rows,
+                  "rounds_done": done, "seconds": round(measured, 3),
+                  "auc": None if auc != auc else round(auc, 5),
+                  "complete": True})
+    if auc == auc and auc < 0.55:  # NaN (predict unavailable) skips the gate
+        # report the timing but MARK it failed — a quality-failing model's
+        # speed must never read as a normal success metric
+        set_final(rows, done, measured, "")
+        final["metric"] += "_quality_failed"
+        final["vs_baseline"] = 0.0
+        print(f"# model quality check failed: test AUC {auc:.4f}",
+              file=sys.stderr, flush=True)
+        return
+    set_final(rows, done, measured, "")
+
+    best_measured = measured
+    # ---- tpu-tuned configuration, AUC-gated at EQUAL rounds ----
+    if args.tuned_max_bin and args.tuned_max_bin != args.max_bin:
+        try:
+            def on_chunk_tuned(t_done, t_measured):
+                _log_partial({"config": f"bin{args.tuned_max_bin}",
+                              "rows": rows, "rounds_done": t_done,
+                              "seconds": round(t_measured, 3)})
+
+            t_done, t_measured, t_auc = _train_measured(
+                xgb, X, y, params_for(args.tuned_max_bin), done,
+                args.budget, args.chunk, on_chunk=on_chunk_tuned)
+            t_rps = t_done / t_measured if t_measured > 0 else 0.0
+            print(f"# [max_bin={args.tuned_max_bin}] rounds/s: {t_rps:.2f}  "
+                  f"test-auc: {t_auc:.4f} (gate: >= {auc:.4f} - 0.002)",
+                  file=sys.stderr, flush=True)
+            _log_partial({"config": f"bin{args.tuned_max_bin}", "rows": rows,
+                          "rounds_done": t_done,
+                          "seconds": round(t_measured, 3),
+                          "auc": None if t_auc != t_auc else round(t_auc, 5),
+                          "complete": True})
+            if (t_done == done and t_auc == t_auc and auc == auc
+                    and t_auc >= auc - 0.002 and t_measured < best_measured):
+                set_final(rows, t_done, t_measured,
+                          f"_bin{args.tuned_max_bin}")
+                print("# tuned config passes AUC parity -> primary metric",
+                      file=sys.stderr, flush=True)
+        except Exception as e:
+            print(f"# tuned run failed ({type(e).__name__}: {e}); "
+                  "keeping reference-default metric", file=sys.stderr,
+                  flush=True)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--rows", type=int, default=1_000_000)
@@ -159,99 +349,40 @@ def main() -> None:
     ap.add_argument("--budget", type=float, default=300.0,
                     help="wall-clock seconds per measured training loop")
     ap.add_argument("--chunk", type=int, default=25)
+    ap.add_argument("--no_probe", action="store_true",
+                    help="skip the subprocess backend probe")
     args = ap.parse_args()
 
-    import jax
-
-    if jax.default_backend() == "tpu":
-        # persistent compilation cache: later runs (and the driver's) skip
-        # the multi-minute XLA/Mosaic compiles. TPU-only: XLA:CPU's AOT
-        # cache reload is machine-feature-sensitive (observed SIGSEGV).
-        os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
-        jax.config.update("jax_compilation_cache_dir",
-                          os.environ["JAX_COMPILATION_CACHE_DIR"])
-    import xgboost_tpu as xgb
-
-    def params_for(max_bin):
-        return {
-            "objective": "binary:logistic",
-            "tree_method": args.tree_method,
-            "max_depth": args.max_depth,
-            "max_bin": max_bin,
-            "eta": 0.1,
-            "verbosity": 1,
-        }
-
-    # ---- smoke: whole pipeline on a tiny shape; failures surface fast ----
-    t0 = time.perf_counter()
-    smoke_rows = min(args.smoke_rows, args.rows)
-    Xs, ys = _make_data(smoke_rows, args.columns, args.sparsity, seed=7)
-    sd, ss, sauc = _train_measured(xgb, Xs, ys, params_for(args.max_bin),
-                                   rounds=3, budget_s=1e9, chunk=3)
-    print(f"# smoke {smoke_rows}x{args.columns} 3r: {ss:.2f}s auc={sauc:.3f} "
-          f"(total incl. compile {time.perf_counter() - t0:.1f}s)",
-          file=sys.stderr, flush=True)
-    if sauc != sauc:
-        raise SystemExit("smoke predict failed — predictor is broken")
-
-    # ---- headline workload, halving rows on hard failure ----
-    rows = args.rows
-    while True:
-        try:
-            X, y = _make_data(rows, args.columns, args.sparsity)
-            done, measured, auc = _train_measured(
-                xgb, X, y, params_for(args.max_bin), args.iterations,
-                args.budget, args.chunk)
-            break
-        except Exception as e:  # OOM / backend error: shrink and retry
-            print(f"# {rows} rows failed: {type(e).__name__}: {e}",
+    # ---- backend probe BEFORE importing jax here: a wedged TPU relay
+    # hangs at interpreter start / first dispatch; detect it in a killable
+    # subprocess and degrade to CPU rather than crash (round-3 BENCH rc=1)
+    suffix = ""
+    if not args.no_probe and "jax" not in sys.modules:
+        backend = _probe_backend()
+        if backend is None:
+            print("# backend unusable -> JAX_PLATFORMS=cpu fallback",
                   file=sys.stderr, flush=True)
-            rows //= 2
-            if rows < 1000:
-                raise SystemExit("benchmark failed at every size")
+            os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            suffix = "_cpu_fallback"
+        else:
+            print(f"# backend probe: {backend}", file=sys.stderr, flush=True)
 
-    rps = done / measured if measured > 0 else 0.0
-    print(f"# [max_bin={args.max_bin}] rounds/s: {rps:.2f}  test-auc: {auc:.4f}",
-          file=sys.stderr, flush=True)
-    if auc == auc and auc < 0.55:  # NaN (predict unavailable) skips the gate
-        raise SystemExit(f"model quality check failed: test AUC {auc:.4f}")
-
-    best_done, best_measured, bin_suffix = done, measured, ""
-    # ---- tpu-tuned configuration, AUC-gated at EQUAL rounds ----
-    if args.tuned_max_bin and args.tuned_max_bin != args.max_bin:
-        try:
-            t_done, t_measured, t_auc = _train_measured(
-                xgb, X, y, params_for(args.tuned_max_bin), done,
-                args.budget, args.chunk)
-            t_rps = t_done / t_measured if t_measured > 0 else 0.0
-            print(f"# [max_bin={args.tuned_max_bin}] rounds/s: {t_rps:.2f}  "
-                  f"test-auc: {t_auc:.4f} (gate: >= {auc:.4f} - 0.002)",
-                  file=sys.stderr, flush=True)
-            if (t_done == done and t_auc == t_auc and auc == auc
-                    and t_auc >= auc - 0.002 and t_measured < best_measured):
-                best_done, best_measured = t_done, t_measured
-                bin_suffix = f"_bin{args.tuned_max_bin}"
-                print("# tuned config passes AUC parity -> primary metric",
-                      file=sys.stderr, flush=True)
-        except Exception as e:
-            print(f"# tuned run failed ({type(e).__name__}: {e}); "
-                  "keeping reference-default metric", file=sys.stderr,
-                  flush=True)
-
-    rps = best_done / best_measured if best_measured > 0 else 0.0
-    name = (f"train_time_{rows // 1000}kx{args.columns}_"
-            f"{args.iterations}r_depth{args.max_depth}{bin_suffix}")
-    if best_done == args.iterations:
-        value = best_measured
-    else:
-        value = args.iterations / rps  # extrapolated full-run time
-        name += f"_extrapolated_from_{best_done}r"
-    print(json.dumps({
-        "metric": name,
-        "value": round(value, 3),
-        "unit": "s",
-        "vs_baseline": round(BASELINE_HIST_SECONDS / value, 3),
-    }))
+    final: dict = {}
+    try:
+        _run_configs(args, suffix, final)
+    except BaseException as e:
+        if isinstance(e, KeyboardInterrupt):
+            print("# interrupted", file=sys.stderr, flush=True)
+        else:
+            traceback.print_exc(file=sys.stderr)
+        print(f"# bench stage died: {type(e).__name__}: {e}; emitting best "
+              "completed measurement", file=sys.stderr, flush=True)
+    finally:
+        if not final:
+            final = {"metric": "train_time_failed", "value": 0.0,
+                     "unit": "s", "vs_baseline": 0.0}
+        print(json.dumps(final), flush=True)
 
 
 if __name__ == "__main__":

@@ -27,3 +27,66 @@ def test_bench_produces_json_line():
     assert set(rec) == {"metric", "value", "unit", "vs_baseline"}
     assert rec["unit"] == "s" and rec["value"] > 0
     assert rec["metric"].startswith("train_time_20kx50_8r_depth6")
+
+
+def test_bench_emits_partial_on_midrun_crash(tmp_path, monkeypatch, capsys):
+    """A stage dying AFTER a completed measurement must still emit that
+    measurement as the final JSON line (round-3 regression: the tuned run
+    crashed and took the completed 256-bin number with it)."""
+    monkeypatch.chdir(tmp_path)
+    sys.path.insert(0, REPO)
+    try:
+        import bench
+    finally:
+        sys.path.remove(REPO)
+
+    def fake_run(args, suffix, final):
+        final.update({"metric": "train_time_1000kx50_500r_depth6",
+                      "value": 12.0, "unit": "s", "vs_baseline": 3.0})
+        raise RuntimeError("relay wedged mid-tuned-run")
+
+    monkeypatch.setattr(bench, "_run_configs", fake_run)
+    monkeypatch.setattr(sys, "argv", ["bench.py", "--no_probe"])
+    bench.main()
+    out = capsys.readouterr().out
+    lines = [ln for ln in out.splitlines() if ln.strip()]
+    assert len(lines) == 1
+    rec = json.loads(lines[0])
+    assert rec["value"] == 12.0 and rec["vs_baseline"] == 3.0
+
+
+def test_bench_emits_error_line_when_nothing_measured(tmp_path, monkeypatch,
+                                                      capsys):
+    monkeypatch.chdir(tmp_path)
+    sys.path.insert(0, REPO)
+    try:
+        import bench
+    finally:
+        sys.path.remove(REPO)
+
+    def fake_run(args, suffix, final):
+        raise SystemExit("smoke predict failed")
+
+    monkeypatch.setattr(bench, "_run_configs", fake_run)
+    monkeypatch.setattr(sys, "argv", ["bench.py", "--no_probe"])
+    bench.main()  # must NOT raise
+    rec = json.loads(capsys.readouterr().out.strip())
+    assert rec["metric"] == "train_time_failed"
+    assert set(rec) == {"metric", "value", "unit", "vs_baseline"}
+
+
+def test_backend_probe_timeout_returns_none(monkeypatch):
+    sys.path.insert(0, REPO)
+    try:
+        import bench
+    finally:
+        sys.path.remove(REPO)
+    calls = []
+
+    def fake_run(cmd, capture_output, text, timeout):
+        calls.append(timeout)
+        raise subprocess.TimeoutExpired(cmd, timeout)
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    assert bench._probe_backend(timeout_s=1.0) is None
+    assert len(calls) == 2  # two attempts before giving up

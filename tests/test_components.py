@@ -603,3 +603,35 @@ def test_get_split_value_histogram():
     assert abs(top - 0.3) < 0.5
     with pytest.raises(ValueError, match="unknown feature"):
         bst.get_split_value_histogram("nope")
+
+
+def test_chunk_backed_model_paths():
+    """update_many stores whole scan chunks (_PendingChunk) instead of
+    per-tree device slices; every consumer — eval-cache catch-up through
+    stacked_slice over _ChunkRefs, mixed chunk+per-round entries, predict
+    on fresh data, JSON save/load — must behave identically."""
+    rng = np.random.RandomState(0)
+    X = rng.randn(800, 6).astype(np.float32)
+    y = (X[:, 0] - X[:, 1] > 0).astype(np.float32)
+    dtrain = xgb.DMatrix(X[:600], label=y[:600])
+    dval = xgb.DMatrix(X[600:], label=y[600:])
+    bst = xgb.Booster({"objective": "binary:logistic", "max_depth": 3},
+                      [dtrain, dval])
+    bst.update_many(dtrain, 0, 7, chunk=3)
+    from xgboost_tpu.gbm.gbtree import _ChunkRef
+
+    model = bst._gbm.model
+    assert any(isinstance(e, _ChunkRef) for e in model._entries)
+    line = bst.eval(dval, "val", 6)  # catch-up walks chunk-backed forest
+    assert "val-logloss" in line
+    bst.update(dtrain, 7)  # mixed: per-round _PendingTree after chunks
+    p = bst.predict(xgb.DMatrix(X))
+    assert p.shape == (800,) and np.isfinite(p).all()
+    import tempfile, os
+
+    with tempfile.TemporaryDirectory() as td:
+        fp = os.path.join(td, "m.json")
+        bst.save_model(fp)
+        b2 = xgb.Booster(model_file=fp)
+        np.testing.assert_allclose(b2.predict(xgb.DMatrix(X)), p,
+                                   rtol=1e-5, atol=1e-6)

