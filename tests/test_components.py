@@ -269,16 +269,19 @@ def test_segmented_rank_metrics_match_per_group_oracle():
         return np.mean(vals)
 
     def oracle_map(k):
+        # reference semantics (rank_metric.cc:321-330): nhits counts hits
+        # over the WHOLE group; only the sumap terms are top-k-gated; the
+        # final division is by the group's total hit count
         vals = []
         for g in range(len(sizes)):
             lo, hi = gptr[g], gptr[g + 1]
             o = np.argsort(-p[lo:hi], kind="stable")
-            rel = (y[lo:hi][o] > 0).astype(float)[:k]
+            rel = (y[lo:hi][o] > 0).astype(float)
             if rel.sum() == 0:
                 vals.append(1.0)
                 continue
             prec = np.cumsum(rel) / (np.arange(len(rel)) + 1)
-            vals.append((prec * rel).sum() / rel.sum())
+            vals.append((prec * rel)[:k].sum() / rel.sum())
         return np.mean(vals)
 
     for k in (5, 10):

@@ -80,6 +80,11 @@ class Booster:
     def _apply_params(self, params: Dict[str, Any]) -> None:
         unknown = self.lparam.update(params)
         self._extra_params.update(unknown)
+        # shared keys consumed by the learner-level ParamSet but ALSO read
+        # by the tree layer (see LearnerParam.FIELDS note): forward them
+        for k in ("max_delta_step",):
+            if k in params:
+                self._extra_params[k] = params[k]
         if self.lparam.validate_parameters:
             self._validate_unknown()
 
@@ -586,6 +591,10 @@ class Booster:
         state = {
             "model": self.save_json() if self._gbm is not None else None,
             "lparam": self.lparam.to_dict(),
+            # which keys the user actually set: replaying to_dict() through
+            # update() would mark every DEFAULT explicit, breaking
+            # explicitness-gated defaults (Poisson's max_delta_step 0.7)
+            "lparam_explicit": sorted(self.lparam._explicit),
             "extra": dict(self._extra_params),
             "attributes": dict(self.attributes_),
         }
@@ -594,6 +603,8 @@ class Booster:
     def __setstate__(self, state):
         self.__init__()
         self.lparam.update({k: v for k, v in state["lparam"].items() if v is not None})
+        self.lparam._explicit = set(
+            state.get("lparam_explicit", state["lparam"]))
         self._extra_params = dict(state["extra"])
         self.attributes_ = dict(state["attributes"])
         if state["model"] is not None:

@@ -40,7 +40,9 @@ class ElementwiseMetric(Metric):
         raise NotImplementedError
 
     def finalize(self, s: float, w: float) -> float:
-        return s / w if w > 0 else float("nan")
+        # the reference's empty/zero-weight convention: wsum == 0 returns
+        # the raw esum, NOT nan (elementwise_metric.cu:7 and every GetFinal)
+        return s if w == 0 else s / w
 
     def evaluate(self, preds, label, weight=None, **kw):
         preds = jnp.asarray(preds)

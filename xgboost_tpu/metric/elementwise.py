@@ -21,7 +21,7 @@ class RMSE(ElementwiseMetric):
         return (p - y) ** 2
 
     def finalize(self, s, w):
-        return math.sqrt(s / w) if w > 0 else float("nan")
+        return math.sqrt(s if w == 0 else s / w)
 
 
 @METRICS.register("rmsle")
@@ -32,7 +32,7 @@ class RMSLE(ElementwiseMetric):
         return (jnp.log1p(jnp.maximum(p, -1 + 1e-6)) - jnp.log1p(y)) ** 2
 
     def finalize(self, s, w):
-        return math.sqrt(s / w) if w > 0 else float("nan")
+        return math.sqrt(s if w == 0 else s / w)
 
 
 @METRICS.register("mae")
@@ -65,7 +65,11 @@ class LogLoss(ElementwiseMetric):
     name = "logloss"
 
     def loss(self, p, y):
-        p = jnp.clip(p, _EPS, 1.0 - _EPS)
+        # the reference's product form (supports fractional labels) with an
+        # f32-REPRESENTABLE clamp: the reference's 1e-16 eps rounds
+        # 1 - eps to exactly 1.0 in f32 and 0 * log(0) = nan
+        eps = 1e-7
+        p = jnp.clip(p, eps, 1.0 - eps)
         return -(y * jnp.log(p) + (1.0 - y) * jnp.log(1.0 - p))
 
 
@@ -111,7 +115,7 @@ class GammaDeviance(ElementwiseMetric):
         return jnp.log(p + e) - jnp.log(y + e) + y / (p + e) - 1.0
 
     def finalize(self, s, w):
-        return 2.0 * s / w if w > 0 else float("nan")
+        return 2.0 * (s if w == 0 else s / w)
 
 
 @METRICS.register("gamma-nloglik")
@@ -127,7 +131,7 @@ class GammaNLogLik(ElementwiseMetric):
         return y / p + jnp.log(p)
 
     def finalize(self, s, w):
-        return s / w if w > 0 else float("nan")
+        return s if w == 0 else s / w
 
 
 @METRICS.register("tweedie-nloglik@", "tweedie-nloglik")

@@ -69,7 +69,8 @@ class NDCG(_PerGroupMetric):
         yi = ys[lorder]
         idcg = np.bincount(group_of, weights=(2.0 ** yi - 1.0) * disc * top,
                            minlength=G)
-        return np.where(idcg > 0, dcg / np.maximum(idcg, 1e-30), 1.0)
+        return np.where(idcg > 0, dcg / np.maximum(idcg, 1e-30),
+                        0.0 if getattr(self, "minus", False) else 1.0)
 
 
 @METRICS.register("map@", "map")
@@ -87,8 +88,12 @@ class MAP(_PerGroupMetric):
         top = local < k
         prec_terms = np.where(top, hits / (local + 1.0) * rel, 0.0)
         num = np.bincount(group_of, weights=prec_terms, minlength=G)
-        den = np.bincount(group_of, weights=rel * top, minlength=G)
-        return np.where(den > 0, num / np.maximum(den, 1e-30), 1.0)
+        # the reference divides by the group's TOTAL hit count, not the
+        # hits inside top-n (rank_metric.cc:321-330: nhits accumulates over
+        # the whole group, only sumap is top-n-gated)
+        den = np.bincount(group_of, weights=rel, minlength=G)
+        return np.where(den > 0, num / np.maximum(den, 1e-30),
+                        0.0 if getattr(self, "minus", False) else 1.0)
 
 
 @METRICS.register("pre@", "pre")

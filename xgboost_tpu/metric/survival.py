@@ -20,8 +20,9 @@ class AFTNLogLik(Metric):
         from ..objective.survival import AFT
 
         obj = AFT()
-        # preds here are exp(margin); recover the margin
-        margin = jnp.log(jnp.maximum(jnp.asarray(preds).reshape(-1), 1e-30))
+        # preds arrive UNtransformed — log space (AFT.eval_transform is a
+        # no-op, like the reference's)
+        margin = jnp.asarray(preds).reshape(-1)
         yl = jnp.asarray(label_lower if label_lower is not None else label)
         yu = jnp.asarray(label_upper if label_upper is not None else label)
         ll = obj._loglik(margin, yl, yu)
@@ -38,10 +39,17 @@ class IntervalAccuracy(Metric):
     maximize = True
 
     def evaluate(self, preds, label, weight=None, label_lower=None, label_upper=None, **kw):
+        # preds live in LOG space (the AFT margin); bounds are linear —
+        # accuracy counts log(lower) <= pred <= log(upper)
+        # (survival_metric.cu IntervalRegressionAccuracy)
         p = np.asarray(preds).reshape(-1)
-        yl = np.asarray(label_lower if label_lower is not None else label)
-        yu = np.asarray(label_upper if label_upper is not None else label)
-        ok = (p >= yl) & ((~np.isfinite(yu)) | (p <= yu))
+        yl = np.asarray(label_lower if label_lower is not None else label,
+                        np.float64)
+        yu = np.asarray(label_upper if label_upper is not None else label,
+                        np.float64)
+        with np.errstate(divide="ignore"):
+            ok = (p >= np.log(np.maximum(yl, 0.0))) & (
+                (~np.isfinite(yu)) | (p <= np.log(np.maximum(yu, 0.0))))
         return float(ok.mean())
 
 

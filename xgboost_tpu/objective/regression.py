@@ -131,10 +131,24 @@ class Hinge(ObjFunction):
 
 @OBJECTIVES.register("count:poisson")
 class Poisson(ObjFunction):
+    def _max_delta_step(self) -> float:
+        """The Poisson-specific max_delta_step (reference
+        regression_obj.cu:197: its OWN param, default 0.7, fed from the
+        same user key as the tree one). Explicitly-set values win,
+        including an explicit 0."""
+        p = self.params
+        if p is not None:
+            v = getattr(p, "max_delta_step", None)
+            if v is not None and (not hasattr(p, "is_explicit")
+                                  or p.is_explicit("max_delta_step")):
+                return float(v)
+        return 0.7
+
     def get_gradient(self, margin, label, weight, iteration=0, **kw):
-        e = jnp.exp(margin)
-        grad = e - label
-        hess = e
+        grad = jnp.exp(margin) - label
+        # hess = exp(p + max_delta_step): the reference's capped-step
+        # hessian inflation (regression_obj.cu:249)
+        hess = jnp.exp(margin + self._max_delta_step())
         return apply_weight(grad, hess, weight)
 
     def pred_transform(self, margin):

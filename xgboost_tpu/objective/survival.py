@@ -27,7 +27,9 @@ def _norm_pdf(z):
 
 
 def _norm_cdf(z):
-    return 0.5 * (1.0 + jax.lax.erf(z / math.sqrt(2.0)))
+    # erfc form: exact in the lower tail where 0.5*(1+erf) cancels to 0
+    # in float32 (the interval-censored AFT denominator lives there)
+    return 0.5 * jax.lax.erfc(-z / math.sqrt(2.0))
 
 
 def _logis_pdf(z):
@@ -56,47 +58,159 @@ _DISTS = {
 }
 
 
+def _normal_hazard(z):
+    """pdf(z)/(1-cdf(z)), stable out to any z: exact ratio where erfc has
+    range, the Mills-ratio asymptote (z + 1/z - 2/z^3) in the far tail."""
+    zc = jnp.minimum(z, 8.0)
+    direct = _norm_pdf(zc) / jnp.maximum(
+        0.5 * jax.lax.erfc(zc / math.sqrt(2.0)), 1e-30)
+    zs = jnp.maximum(z, 1.0)
+    asym = zs + 1.0 / zs - 2.0 / zs ** 3
+    return jnp.where(z > 8.0, asym, direct)
+
+
 @OBJECTIVES.register("survival:aft")
 class AFT(ObjFunction):
-    """Accelerated failure time with censoring. Gradients computed
-    numerically-stably via autodiff of the interval log-likelihood — same
-    math as the closed forms in survival_util.h, but one source."""
+    """Accelerated failure time with censoring, in the reference's closed
+    forms (``src/common/survival_util.h``: per-distribution grad/hess for
+    uncensored / right- / left- / interval-censored rows, gradients clipped
+    to +-15 and hessians to [1e-16, 15] — kMin/MaxGradient, kMin/MaxHessian
+    there). Float32-stable compositions: the normal censoring terms go
+    through a guarded hazard (Mills asymptote in the far tail), the
+    logistic ones through sigmoids, the extreme ones through the exact
+    algebraic ratios; non-finite fallout in the doubly-saturated interval
+    tail rails to the clamp of the correct sign."""
 
     task = Task.SURVIVAL
 
     def _loglik(self, margin, y_lower, y_upper):
-        dist = getattr(self.params, "aft_loss_distribution", "normal") if self.params else "normal"
-        sigma = getattr(self.params, "aft_loss_distribution_scale", 1.0) if self.params else 1.0
+        """Interval log-likelihood (used by the aft-nloglik metric;
+        training uses the closed-form gradients below)."""
+        p = self.params
+        dist = getattr(p, "aft_loss_distribution", "normal") if p else "normal"
+        sigma = float(getattr(p, "aft_loss_distribution_scale", 1.0) or 1.0) if p else 1.0
         pdf, cdf = _DISTS[dist]
         log_yl = jnp.log(jnp.maximum(y_lower, _EPS))
         z_l = (log_yl - margin) / sigma
         uncensored = y_upper == y_lower
         inf_upper = ~jnp.isfinite(y_upper)
-        log_yu = jnp.log(jnp.maximum(jnp.where(jnp.isfinite(y_upper), y_upper, 1.0), _EPS))
+        log_yu = jnp.log(jnp.maximum(
+            jnp.where(jnp.isfinite(y_upper), y_upper, 1.0), _EPS))
         z_u = (log_yu - margin) / sigma
-        # uncensored: log pdf(z)/sigma ; right-censored: log(1-cdf(zl));
-        # interval: log(cdf(zu)-cdf(zl))
         ll_unc = jnp.log(jnp.maximum(pdf(z_l), _EPS) / sigma)
         ll_right = jnp.log(jnp.maximum(1.0 - cdf(z_l), _EPS))
         ll_int = jnp.log(jnp.maximum(cdf(z_u) - cdf(z_l), _EPS))
-        return jnp.where(uncensored, ll_unc, jnp.where(inf_upper, ll_right, ll_int))
+        return jnp.where(uncensored, ll_unc,
+                         jnp.where(inf_upper, ll_right, ll_int))
 
-    def get_gradient(self, margin, label, weight, iteration=0, *, label_lower=None, label_upper=None, **kw):
+    def get_gradient(self, margin, label, weight, iteration=0, *,
+                     label_lower=None, label_upper=None, **kw):
         if label_lower is None:
             label_lower = label
         if label_upper is None:
             label_upper = label
-        neg_ll = lambda m: -self._loglik(m, label_lower, label_upper).sum()
-        grad = jax.grad(neg_ll)(margin)
-        # diagonal hessian via grad-of-grad vectorized with HVP on ones is
-        # wrong for coupled losses, but AFT is elementwise => exact
-        hess = jax.grad(lambda m: jax.grad(neg_ll)(m).sum())(margin)
+        p = self.params
+        dist = getattr(p, "aft_loss_distribution", "normal") if p else "normal"
+        sigma = float(getattr(p, "aft_loss_distribution_scale", 1.0) or 1.0) if p else 1.0
+        y_l = jnp.asarray(label_lower, jnp.float32)
+        y_u = jnp.asarray(label_upper, jnp.float32)
+        log_yl = jnp.where(y_l > 0, jnp.log(jnp.maximum(y_l, _EPS)), -jnp.inf)
+        finite_u = jnp.isfinite(y_u)
+        log_yu = jnp.where(finite_u,
+                           jnp.log(jnp.maximum(jnp.where(finite_u, y_u, 1.0),
+                                               _EPS)), jnp.inf)
+        z_l = (log_yl - margin) / sigma  # -inf when y_l == 0
+        z_u = (log_yu - margin) / sigma  # +inf when right-censored
+        zl_f = jnp.where(jnp.isfinite(z_l), z_l, 0.0)
+        zu_f = jnp.where(jnp.isfinite(z_u), z_u, 0.0)
+
+        if dist == "normal":
+            pdf_l = jnp.where(jnp.isfinite(z_l), _norm_pdf(zl_f), 0.0)
+            pdf_u = jnp.where(jnp.isfinite(z_u), _norm_pdf(zu_f), 0.0)
+            dpdf_l = -zl_f * pdf_l  # pdf'(z); 0 at infinite z
+            dpdf_u = -zu_f * pdf_u
+            cdf_l = jnp.where(jnp.isfinite(z_l), _norm_cdf(zl_f), 0.0)
+            cdf_u = jnp.where(jnp.isfinite(z_u), _norm_cdf(zu_f), 1.0)
+            g_unc = -z_l / sigma
+            h_unc = jnp.ones_like(margin) / sigma ** 2
+            hz = _normal_hazard(zl_f)  # right-censored hazard
+            g_right = -hz / sigma
+            h_right = hz * (hz - zl_f) / sigma ** 2
+            rh = _normal_hazard(-zu_f)  # left-censored: mirrored hazard
+            g_left = rh / sigma
+            h_left = rh * (rh + zu_f) / sigma ** 2
+        elif dist == "logistic":
+            sig_l = _logis_cdf(zl_f)
+            sig_u = _logis_cdf(zu_f)
+            pdf_l = jnp.where(jnp.isfinite(z_l), _logis_pdf(zl_f), 0.0)
+            pdf_u = jnp.where(jnp.isfinite(z_u), _logis_pdf(zu_f), 0.0)
+            dpdf_l = pdf_l * (1.0 - 2.0 * sig_l)
+            dpdf_u = pdf_u * (1.0 - 2.0 * sig_u)
+            cdf_l = jnp.where(jnp.isfinite(z_l), sig_l, 0.0)
+            cdf_u = jnp.where(jnp.isfinite(z_u), sig_u, 1.0)
+            g_unc = (1.0 - 2.0 * sig_l) / sigma
+            h_unc = 2.0 * pdf_l / sigma ** 2
+            g_right = -sig_l / sigma  # pdf/S = sigmoid(z), exact
+            h_right = pdf_l / sigma ** 2
+            g_left = (1.0 - sig_u) / sigma  # pdf/F = sigmoid(-z), exact
+            h_left = pdf_u / sigma ** 2
+        else:  # extreme (Gumbel minimum)
+            w_l = jnp.exp(jnp.clip(zl_f, -50.0, 50.0))
+            w_u = jnp.exp(jnp.clip(zu_f, -50.0, 50.0))
+            pdf_l = jnp.where(jnp.isfinite(z_l), _extreme_pdf(zl_f), 0.0)
+            pdf_u = jnp.where(jnp.isfinite(z_u), _extreme_pdf(zu_f), 0.0)
+            dpdf_l = pdf_l * (1.0 - w_l)
+            dpdf_u = pdf_u * (1.0 - w_u)
+            cdf_l = jnp.where(jnp.isfinite(z_l), _extreme_cdf(zl_f), 0.0)
+            cdf_u = jnp.where(jnp.isfinite(z_u), _extreme_cdf(zu_f), 1.0)
+            g_unc = (1.0 - w_l) / sigma
+            h_unc = w_l / sigma ** 2
+            g_right = -w_l / sigma  # pdf/S = w, exact
+            h_right = w_l / sigma ** 2
+            # left-censored: pdf/F = w/(e^w - 1), exact via expm1
+            E = jnp.expm1(jnp.minimum(w_u, 80.0))
+            g_left = w_u / jnp.maximum(E, 1e-30) / sigma
+            h_left = (w_u * (w_u * (E + 1.0) - E)
+                      / jnp.maximum(E * E, 1e-30)) / sigma ** 2
+
+        # interval / left-censored shared form: loss = -log(F_u - F_l)
+        D = cdf_u - cdf_l
+        N = pdf_u - pdf_l
+        g_int = N / (sigma * jnp.maximum(D, 1e-30))
+        h_int = g_int * g_int + (dpdf_l - dpdf_u) / (
+            sigma ** 2 * jnp.maximum(D, 1e-30))
+
+        uncensored = y_u == y_l
+        right = ~finite_u
+        left = y_l <= 0  # z_l = -inf: pure left censoring
+        grad = jnp.where(uncensored, g_unc,
+                         jnp.where(right, g_right,
+                                   jnp.where(left, g_left, g_int)))
+        hess = jnp.where(uncensored, h_unc,
+                         jnp.where(right, h_right,
+                                   jnp.where(left, h_left, h_int)))
+
+        # doubly-saturated tails (D underflowed to 0): rail with the sign
+        # of the side the prediction fell past, like the double-precision
+        # reference saturating through its Clip (survival_util.h)
+        blown = ~jnp.isfinite(grad) | (~uncensored & ~right & ~left
+                                       & (D <= 0))
+        rail = jnp.where(z_u + z_l < 0, _MAX_G, -_MAX_G)
+        rail = jnp.where(jnp.isfinite(z_u + z_l), rail,
+                         jnp.where(zu_f + zl_f < 0, _MAX_G, -_MAX_G))
+        grad = jnp.where(blown, rail, grad)
+        hess = jnp.where(blown | ~jnp.isfinite(hess), _MAX_G, hess)
         grad = jnp.clip(grad, -_MAX_G, _MAX_G)
         hess = jnp.clip(hess, _MIN_H, _MAX_G)
         return apply_weight(grad, hess, weight)
 
     def pred_transform(self, margin):
         return jnp.exp(margin)
+
+    def eval_transform(self, margin):
+        # no-op: the AFT metrics expect the UNtransformed (log-space)
+        # score (reference aft_obj.cu:117 EvalTransform comment)
+        return margin
 
     def prob_to_margin(self, base_score):
         return math.log(max(base_score, 1e-16))
@@ -108,30 +222,40 @@ class AFT(ObjFunction):
 @OBJECTIVES.register("survival:cox")
 class CoxPH(ObjFunction):
     """Cox proportional hazards partial likelihood (reference:
-    ``regression_obj.cu:400`` CoxRegression — negative labels mark censored
-    rows; data assumed sorted by observed time ascending, as the reference
-    requires)."""
+    ``regression_obj.cu:304`` CoxRegression — negative labels mark
+    censored rows). Matching the reference exactly: rows are processed in
+    |label| ascending order (``MetaInfo::LabelAbsSort``, so the input need
+    NOT be pre-sorted), the risk-set denominator is held constant across
+    tied times (Breslow's method, the ``last_abs_y < abs_y`` gate at
+    :354), and ``r_k``/``s_k`` accumulate 1/denominator at event rows
+    inclusively."""
 
     task = Task.SURVIVAL
 
     def get_gradient(self, margin, label, weight, iteration=0, **kw):
-        # risk set of row i = rows with time >= t_i  (suffix sums given the
-        # required time-ascending sort)
-        exp_p = jnp.exp(margin)
-        w = weight if weight is not None else jnp.ones_like(margin)
-        # suffix cumulative sums of exp(pred)
-        rev = lambda x: x[::-1]
-        r_k = rev(jnp.cumsum(rev(exp_p * 1.0)))  # sum_{j: j >= i} exp_p[j]
-        # accumulated censoring terms: for each event row e (label>0),
-        # rows i <= e get + exp_p[i]/r_k[e] style terms
-        is_event = label > 0
-        inv_r = jnp.where(is_event, 1.0 / jnp.maximum(r_k, 1e-30), 0.0)
-        inv_r2 = jnp.where(is_event, 1.0 / jnp.maximum(r_k * r_k, 1e-30), 0.0)
-        acc1 = jnp.cumsum(inv_r)  # prefix: sum over events e <= i of 1/r_e
-        acc2 = jnp.cumsum(inv_r2)
-        grad = exp_p * acc1 - is_event.astype(margin.dtype)
-        hess = exp_p * acc1 - (exp_p ** 2) * acc2
-        return apply_weight(grad * 1.0, jnp.maximum(hess, 1e-16), None if weight is None else w)
+        n = margin.shape[0]
+        abs_y = jnp.abs(label)
+        order = jnp.argsort(abs_y)  # stable, ascending |time|
+        exp_s = jnp.exp(margin)[order]
+        ys = label[order]
+        abs_s = abs_y[order]
+        # suffix sums of exp(p); the risk set of row i is every row whose
+        # |time| >= |time_i|, i.e. the suffix starting at i's TIE GROUP's
+        # first row (Breslow: tied times share one denominator)
+        suffix = jnp.cumsum(exp_s[::-1])[::-1]
+        idx = jnp.arange(n)
+        first = jnp.concatenate(
+            [jnp.ones((1,), bool), abs_s[1:] != abs_s[:-1]])
+        group_start = jax.lax.cummax(jnp.where(first, idx, 0))
+        denom = jnp.maximum(suffix[group_start], 1e-30)
+        event = ys > 0
+        r_k = jnp.cumsum(jnp.where(event, 1.0 / denom, 0.0))  # inclusive
+        s_k = jnp.cumsum(jnp.where(event, 1.0 / (denom * denom), 0.0))
+        grad_s = exp_s * r_k - event.astype(margin.dtype)
+        hess_s = exp_s * r_k - exp_s * exp_s * s_k
+        grad = jnp.zeros_like(margin).at[order].set(grad_s)
+        hess = jnp.zeros_like(margin).at[order].set(hess_s)
+        return apply_weight(grad, hess, weight)
 
     def pred_transform(self, margin):
         return jnp.exp(margin)

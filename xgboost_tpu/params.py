@@ -205,4 +205,10 @@ class LearnerParam(ParamSet):
         "max_pairs": Field(100),  # ranking pair sampling cap per group
         "lambdarank_num_pair_per_sample": Field(1, lower=1),
         "device": Field(""),
+        # read by BOTH layers: the tree updater's TrainParam AND the
+        # Poisson objective (reference keeps two params fed from one key:
+        # tree/param.h max_delta_step and regression_obj.cu:197
+        # PoissonRegressionParam, whose own default is 0.7). The learner
+        # forwards it onward to the gbm (learner.py:_apply_params).
+        "max_delta_step": Field(0.0, lower=0.0),
     }

@@ -61,9 +61,20 @@ LINEAR_UPDATERS: Registry = Registry("linear updater")
 
 def create_metric(name: str):
     """Create a metric, handling parameterized names like ``error@0.7``,
-    ``ndcg@5`` (reference: ``src/metric/metric.cc`` name parsing)."""
-    if "@" in name:
-        base, _, arg = name.partition("@")
-        if base in METRICS:
-            return METRICS.create(base + "@", arg, full_name=name)
-    return METRICS.create(name)
+    ``ndcg@5``, and the trailing-minus empty-group convention ``ndcg-`` /
+    ``map@2-`` (reference: ``src/metric/metric.cc`` name parsing +
+    EvalRank's ``minus`` flag, rank_metric.cc:248)."""
+    minus = name.endswith("-")
+    core = name[:-1] if minus else name
+    if "@" in core:
+        base, _, arg = core.partition("@")
+        if base in METRICS or base + "@" in METRICS:
+            m = METRICS.create(base + "@", arg, full_name=name)
+        else:
+            m = METRICS.create(core)
+    else:
+        m = METRICS.create(core)
+    if minus:
+        m.name = name
+        m.minus = True  # empty/relevance-free groups score 0, not 1
+    return m
