@@ -135,3 +135,49 @@ def test_paged_training_equals_streaming_at_scale():
     probe = xgb.DMatrix(X[:20000])
     np.testing.assert_allclose(bext.predict(probe), bstr.predict(probe),
                                rtol=1e-4, atol=1e-5)
+
+
+def test_external_memory_predict_eval_early_stop(tmp_path):
+    """Page-streamed predict/eval on the paged matrix itself (reference:
+    cpu_predictor.cc:266 page-streamed prediction): predictions must be
+    EXACT vs walking the same model over midpoint-densified pages, eval
+    sets and early stopping must work out-of-core, and the margin-cache
+    eval during training must agree with post-hoc predict."""
+    parts, labels, w = _make(n_parts=4, rows=600, F=6, seed=3)
+    d_ext = xgb.ExternalMemoryQuantileDMatrix(
+        _ArrayIter(parts, labels), cache_prefix=str(tmp_path / "c1"),
+        max_bin=32, page_rows=777)  # unaligned pages
+    params = {"objective": "binary:logistic", "max_depth": 3, "eta": 0.3,
+              "max_bin": 32, "eval_metric": "auc"}
+    res = {}
+    bst = xgb.train(params, d_ext, 12, evals=[(d_ext, "train")],
+                    evals_result=res, verbose_eval=False)
+    aucs = res["train"]["auc"]
+    assert aucs[-1] > max(aucs[0], 0.85)
+
+    # predict on the paged matrix == predict on its midpoint densification
+    p_ext = bst.predict(d_ext)
+    paged = d_ext._paged
+    X_mid = np.concatenate([paged.float_page(k)
+                            for k in range(paged.n_pages)])
+    p_mid = bst.predict(xgb.DMatrix(X_mid))
+    np.testing.assert_allclose(p_ext, p_mid, rtol=1e-6, atol=1e-7)
+
+    # eval-set AUC line equals metric on streamed predictions
+    y = np.concatenate(labels)
+    auc = float(create_metric("auc").evaluate(p_ext, y))
+    assert abs(auc - aucs[-1]) < 1e-4
+
+    # early stopping entirely out-of-core: noisy labels stop early
+    rng = np.random.RandomState(9)
+    noisy = [rng.randint(0, 2, len(l)).astype(np.float32) for l in labels]
+    d_noise = xgb.ExternalMemoryQuantileDMatrix(
+        _ArrayIter(parts, noisy), cache_prefix=str(tmp_path / "c2"),
+        max_bin=32, page_rows=777)
+    bst2 = xgb.train(params, d_ext, 60, evals=[(d_noise, "val")],
+                     early_stopping_rounds=5, verbose_eval=False)
+    assert bst2.best_iteration < 59
+
+    # pred_leaf streams pages too
+    leaves = bst.predict(d_ext, pred_leaf=True)
+    assert leaves.shape[0] == d_ext.num_row()

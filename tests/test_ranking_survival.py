@@ -4,6 +4,7 @@
 import numpy as np
 import pytest
 
+import jax
 import jax.numpy as jnp
 import xgboost_tpu as xgb
 
@@ -183,3 +184,146 @@ def test_ranking_sampled_matches_allpairs_direction():
         R._ALL_PAIRS_BUDGET = old_budget
     corr = np.corrcoef(np.asarray(g_all), np.asarray(g_s))[0, 1]
     assert corr > 0.7, corr
+
+
+def _map_delta_oracle(preds, labels):
+    """Direct numpy transcription of the reference's MAP delta math
+    (rank_obj.cu:474 GetMAPStats + :436 GetLambdaMAP) for ONE group.
+    Returns delta[i, j] for every ordered doc pair (by original index)."""
+    n = len(preds)
+    order = np.argsort(-np.asarray(preds), kind="stable")
+    pos_of = np.empty(n, np.int64)
+    pos_of[order] = np.arange(n)
+    sorted_labels = np.asarray(labels)[order]
+    hit, a1, a2, a3 = 0.0, 0.0, 0.0, 0.0
+    acc1, acc2, acc3, hits = [], [], [], []
+    for i in range(1, n + 1):
+        if sorted_labels[i - 1] > 0:
+            hit += 1
+            a1 += hit / i
+            a2 += (hit - 1) / i
+            a3 += (hit + 1) / i
+        acc1.append(a1); acc2.append(a2); acc3.append(a3); hits.append(hit)
+
+    def lam(pi, ni, pl, nl):
+        if pi == ni or hits[-1] == 0:
+            return 0.0
+        if pi > ni:
+            pi, ni, pl, nl = ni, pi, nl, pl
+        original = acc1[ni] - (acc1[pi - 1] if pi else 0.0)
+        l1, l2 = float(pl > 0), float(nl > 0)
+        if l1 == l2:
+            return 0.0
+        if l1 < l2:
+            changed = acc3[ni - 1] - acc3[pi] + (hits[pi] + 1.0) / (pi + 1)
+        else:
+            changed = acc2[ni - 1] - acc2[pi] + hits[ni] / (ni + 1)
+        return abs(changed - original) / hits[-1]
+
+    out = np.zeros((n, n))
+    for i in range(n):
+        for j in range(n):
+            out[i, j] = lam(pos_of[i], pos_of[j], labels[i], labels[j])
+    return out
+
+
+def test_rank_map_deltas_match_reference_oracle():
+    """Both the padded all-pairs path and the sampled path must weight
+    pairs with the reference's exact MAP deltas."""
+    from xgboost_tpu.objective.ranking import (
+        _lambda_grad,
+        _lambda_grad_sampled,
+    )
+
+    rng = np.random.RandomState(11)
+    sizes = [7, 12, 5]
+    gptr = np.concatenate([[0], np.cumsum(sizes)])
+    n = int(gptr[-1])
+    p = rng.randn(n).astype(np.float32)
+    y = rng.randint(0, 2, n).astype(np.float32)
+
+    # oracle gradient: all-pairs RankNet lambdas weighted by MAP deltas
+    g_oracle = np.zeros(n)
+    for g in range(len(sizes)):
+        lo, hi = gptr[g], gptr[g + 1]
+        deltas = _map_delta_oracle(p[lo:hi], y[lo:hi])
+        for i in range(sizes[g]):
+            for j in range(sizes[g]):
+                if y[lo + i] > y[lo + j]:
+                    rho = 1.0 / (1.0 + np.exp(p[lo + i] - p[lo + j]))
+                    lamv = rho * deltas[i, j]
+                    g_oracle[lo + i] -= lamv
+                    g_oracle[lo + j] += lamv
+
+    group_of = np.repeat(np.arange(3, dtype=np.int32), sizes)
+    rig = np.concatenate([np.arange(s, dtype=np.int32) for s in sizes])
+    g_pad, _ = _lambda_grad(jnp.asarray(p), jnp.asarray(y),
+                            jnp.asarray(group_of), jnp.asarray(rig),
+                            3, max(sizes), "map")
+    np.testing.assert_allclose(np.asarray(g_pad), g_oracle, atol=1e-5)
+
+    # sampled path: each unordered pair is drawn from both ends, so
+    # E[sampled grad] = (2 * n_pair / group_size) * all-pairs grad —
+    # rescale per group, then many draws must closely recover the oracle
+    starts = np.asarray(gptr[:-1], np.int32)
+    n_pair = 256
+    g_s, _ = _lambda_grad_sampled(
+        jnp.asarray(p), jnp.asarray(y), jnp.asarray(group_of),
+        jnp.asarray(starts[group_of]),
+        jnp.asarray(np.asarray(sizes, np.int32)[group_of]),
+        jax.random.PRNGKey(0), 3, n_pair, "map")
+    size_row = np.asarray(sizes)[group_of].astype(float)
+    gs = np.asarray(g_s) * size_row / (2.0 * n_pair)
+    corr = np.corrcoef(gs, g_oracle)[0, 1]
+    assert corr > 0.98, corr
+    rel_err = np.linalg.norm(gs - g_oracle) / np.linalg.norm(g_oracle)
+    assert rel_err < 0.2, rel_err
+
+
+def test_rank_map_differs_from_pairwise_and_improves_map():
+    rng = np.random.RandomState(4)
+    G, S = 40, 12
+    n = G * S
+    X = rng.randn(n, 6).astype(np.float32)
+    w = rng.randn(6)
+    rel = (X @ w + 0.7 * rng.randn(n) > 0.6).astype(np.float32)
+    qid = np.repeat(np.arange(G), S)
+    d = xgb.DMatrix(X, label=rel, qid=qid)
+    res_m, res_p = {}, {}
+    bm = xgb.train({"objective": "rank:map", "max_depth": 3,
+                    "eval_metric": "map@5", "seed": 7},
+                   d, 15, evals=[(d, "t")], evals_result=res_m,
+                   verbose_eval=False)
+    bp = xgb.train({"objective": "rank:pairwise", "max_depth": 3,
+                    "eval_metric": "map@5", "seed": 7},
+                   d, 15, evals=[(d, "t")], evals_result=res_p,
+                   verbose_eval=False)
+    m_hist = res_m["t"]["map@5"]
+    assert m_hist[-1] > m_hist[0]  # map@n improves during training
+    # the two objectives genuinely differ now
+    assert not np.allclose(bm.predict(d), bp.predict(d))
+
+
+def test_aft_nloglik_metric_uses_configured_distribution():
+    """aft-nloglik must evaluate with the objective's configured
+    distribution/scale (reference survival_metric.cu shares AFTParam), not
+    a fresh default."""
+    rng = np.random.RandomState(1)
+    X = rng.randn(300, 3).astype(np.float32)
+    t = np.exp(X[:, 0] + 0.1 * rng.randn(300)).astype(np.float32)
+    d = xgb.DMatrix(X, label_lower_bound=t, label_upper_bound=t * 1.5)
+    out = {}
+    xgb.train({"objective": "survival:aft",
+               "aft_loss_distribution": "logistic",
+               "aft_loss_distribution_scale": 2.0,
+               "eval_metric": "aft-nloglik", "max_depth": 2},
+              d, 3, evals=[(d, "t")], evals_result=out, verbose_eval=False)
+    v_logistic = out["t"]["aft-nloglik"][-1]
+    out2 = {}
+    xgb.train({"objective": "survival:aft",
+               "aft_loss_distribution": "normal",
+               "aft_loss_distribution_scale": 1.0,
+               "eval_metric": "aft-nloglik", "max_depth": 2},
+              d, 3, evals=[(d, "t")], evals_result=out2, verbose_eval=False)
+    # different configured distributions must yield different metric values
+    assert abs(v_logistic - out2["t"]["aft-nloglik"][-1]) > 1e-4

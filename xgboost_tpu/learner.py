@@ -315,6 +315,11 @@ class Booster:
             if not names and not self.lparam.disable_default_eval_metric:
                 names = [self._obj.default_metric()]
             self._metrics = [create_metric(n) for n in names]
+            for m in self._metrics:
+                # metrics that share objective configuration (aft-nloglik's
+                # distribution/scale — the reference configures the metric
+                # with the same AFTParam, survival_metric.cu) read it here
+                m.lparam = self.lparam
         return self._metrics
 
     def eval_set(self, evals, iteration: int = 0, feval=None, output_margin: bool = True) -> str:
@@ -347,6 +352,26 @@ class Booster:
     # ------------------------------------------------------------------
     # prediction
     # ------------------------------------------------------------------
+    def _data_blocks(self, dmat: DMatrix, blk: int = 65536):
+        """Yield (lo, hi, X_block) over a matrix's rows WITHOUT densifying
+        the whole thing: disk-backed matrices stream quantized pages
+        (reconstructed from cut midpoints — the reference's page-streamed
+        predict, cpu_predictor.cc:266), CSR-backed ones densify row
+        blocks, plain ones yield their array once."""
+        n = dmat.num_row()
+        paged = getattr(dmat, "_paged", None)
+        if paged is not None:
+            for k in range(paged.n_pages):
+                lo = k * paged.page_rows
+                yield lo, lo + paged.rows_of(k), jnp.asarray(
+                    paged.float_page(k))
+        elif getattr(dmat, "_sparse", None) is not None and dmat._data is None:
+            for lo in range(0, n, blk):
+                hi = min(lo + blk, n)
+                yield lo, hi, dmat._sparse.dense_rows(lo, hi)
+        else:
+            yield 0, n, dmat.data
+
     def _predict_margin(self, dmat: DMatrix, iteration_range=None) -> jax.Array:
         self._configure()
         n = dmat.num_row()
@@ -362,7 +387,10 @@ class Booster:
             if tw is not None:
                 per_round = max(1, self._gbm.n_groups) * self._gbm.gbtree_param.num_parallel_tree
                 tw = tw[lo * per_round : hi * per_round]
-            return _pm(sub.stacked(), dmat.data, base, tw)
+            stacked = sub.stacked()
+            parts = [_pm(stacked, X, base[blo:bhi], tw)
+                     for blo, bhi, X in self._data_blocks(dmat)]
+            return jnp.concatenate(parts, axis=0) if len(parts) > 1 else parts[0]
         # cache fast path for full-model predictions, with INCREMENTAL
         # catch-up: only trees not yet folded into the cache are walked
         # (reference: gbtree.cc:519 'cache hit? only new trees applied').
@@ -381,7 +409,6 @@ class Booster:
             entry is not None
             and self._gbm.name == "gbtree"
             and entry.margin is not None
-            and getattr(dmat, "_sparse", None) is None
             and 0 < entry.num_trees < cur
             # far behind (e.g. predicting after a long training run with no
             # intermediate evals): one full pass beats replaying per-round
@@ -393,30 +420,30 @@ class Booster:
             while entry.num_trees < cur:
                 hi = min(entry.num_trees + per_round, cur)
                 # stacked_slice keeps device trees on device — no host
-                # materialization from inside the eval loop
+                # materialization from inside the eval loop; data streams
+                # in blocks (pages / CSR row blocks / one dense array), so
+                # out-of-core eval sets catch up in O(new trees) too
                 sub = model.stacked_slice(entry.num_trees, hi)
-                zero = jnp.zeros((n, K), jnp.float32)
-                entry.margin = entry.margin + _pm(sub, dmat.data, zero)
+                parts = [
+                    _pm(sub, X, jnp.zeros((bhi - blo, K), jnp.float32))
+                    for blo, bhi, X in self._data_blocks(dmat)
+                ]
+                delta = (jnp.concatenate(parts, axis=0) if len(parts) > 1
+                         else parts[0])
+                entry.margin = entry.margin + delta
                 entry.num_trees = hi
             return entry.margin
         if cur == 0:
             # empty model: don't touch dmat.data (streaming matrices
             # reconstruct raw values lazily — the zero-tree margin is base)
             margin = base
-        elif getattr(dmat, "_sparse", None) is not None and dmat._data is None:
-            # sparse input: densify ROW BLOCKS on the fly so a full dense
-            # float copy is never resident (reference predictors likewise
-            # walk SparsePage batches, cpu_predictor.cc)
-            blk = 65536
-            parts = []
-            for lo in range(0, n, blk):
-                hi = min(lo + blk, n)
-                parts.append(self._gbm.predict(
-                    dmat._sparse.dense_rows(lo, hi), base[lo:hi]))
+        else:
+            # stream whatever the matrix is backed by: quantized disk
+            # pages, CSR row blocks, or one dense array (_data_blocks)
+            parts = [self._gbm.predict(X, base[blo:bhi])
+                     for blo, bhi, X in self._data_blocks(dmat)]
             margin = (jnp.concatenate(parts, axis=0) if len(parts) > 1
                       else parts[0] if parts else base)
-        else:
-            margin = self._gbm.predict(dmat.data, base)
         if entry is not None and self._gbm.name == "gbtree":
             entry.margin = margin
             entry.num_trees = cur
@@ -445,8 +472,9 @@ class Booster:
             )
             iteration_range = (0, max(1, ntree_limit // per_round))
         if pred_leaf:
-            leaves = self._gbm.predict_leaf(data.data)
-            return np.asarray(leaves)
+            parts = [np.asarray(self._gbm.predict_leaf(X))
+                     for _, _, X in self._data_blocks(data)]
+            return np.concatenate(parts, axis=0) if len(parts) > 1 else parts[0]
         if pred_contribs or pred_interactions:
             from .interpret import predict_contribs, predict_interactions
 

@@ -19,7 +19,9 @@ class AFTNLogLik(Metric):
 
         from ..objective.survival import AFT
 
-        obj = AFT()
+        # configured like the objective: same distribution + scale
+        # (reference survival_metric.cu parses the same AFTParam)
+        obj = AFT(getattr(self, "lparam", None))
         # preds arrive UNtransformed — log space (AFT.eval_transform is a
         # no-op, like the reference's)
         margin = jnp.asarray(preds).reshape(-1)

@@ -34,6 +34,22 @@ def _pad_groups(group_ptr: np.ndarray) -> Tuple[np.ndarray, int]:
     return sizes, max_size
 
 
+def _map_pair_delta(gather, hits, acc1, acc2, acc3, a, b, lab_a, lab_b,
+                    total):
+    """|delta AP| of swapping the docs at sorted positions ``a < b``
+    (rank_obj.cu:436 GetLambdaMAP), shared by the padded and sampled paths;
+    ``gather(arr, idx)`` resolves a (possibly local) position index into the
+    caller's stats layout, returning 0 for idx == -1 (exclusive prefix)."""
+    original = gather(acc1, b) - gather(acc1, a - 1)
+    up = gather(acc3, b - 1) - gather(acc3, a) \
+        + (gather(hits, a) + 1.0) / (a + 1.0)
+    down = gather(acc2, b - 1) - gather(acc2, a) \
+        + gather(hits, b) / (b + 1.0)
+    changed = jnp.where(lab_a < lab_b, up, down)
+    delta = jnp.abs(changed - original) / jnp.maximum(total, 1.0)
+    return jnp.where((lab_a != lab_b) & (a != b) & (total > 0), delta, 0.0)
+
+
 @partial(jax.jit, static_argnames=("n_groups", "max_size", "scheme"))
 def _lambda_grad(
     margin: jax.Array,  # [n]
@@ -74,7 +90,36 @@ def _lambda_grad(
                 / idcg
             )
             w_pair = jnp.where(pair, delta, 0.0)
-        else:  # pairwise (and map approximated by pairwise delta=1)
+        elif scheme == "map":
+            # true MAP delta weights (rank_obj.cu:378 MAPLambdaWeightComputer):
+            # prefix stats over the prediction-sorted list — ap_acc,
+            # ap_acc_miss (a positive removed), ap_acc_add (a positive
+            # inserted ahead), hit counts — then |delta AP| of swapping the
+            # pair's sorted positions
+            order = jnp.argsort(-jnp.where(v, m, -jnp.inf))
+            ranks = jnp.zeros_like(order).at[order].set(jnp.arange(max_size))
+            rel = ((y > 0) & v).astype(m.dtype)
+            rel_sorted = jnp.zeros((max_size,), m.dtype).at[ranks].set(rel)
+            hits = jnp.cumsum(rel_sorted)  # inclusive per position
+            p1 = jnp.arange(max_size, dtype=m.dtype) + 1.0
+            acc1 = jnp.cumsum(rel_sorted * hits / p1)
+            acc2 = jnp.cumsum(rel_sorted * (hits - 1.0) / p1)
+            acc3 = jnp.cumsum(rel_sorted * (hits + 1.0) / p1)
+            total = hits[-1]
+
+            def at(arr, idx):  # gather; idx == -1 -> 0 (exclusive prefix)
+                return jnp.where(idx >= 0,
+                                 arr[jnp.clip(idx, 0, max_size - 1)], 0.0)
+
+            ri, rj = ranks[:, None], ranks[None, :]
+            a, b = jnp.minimum(ri, rj), jnp.maximum(ri, rj)
+            rel_i, rel_j = rel[:, None], rel[None, :]
+            lab_a = jnp.where(ri <= rj, rel_i, rel_j)  # binary, earlier pos
+            lab_b = jnp.where(ri <= rj, rel_j, rel_i)
+            delta = _map_pair_delta(at, hits, acc1, acc2, acc3, a, b,
+                                    lab_a, lab_b, total)
+            w_pair = jnp.where(pair, delta, 0.0)
+        else:  # pairwise: unit delta
             w_pair = jnp.where(pair, 1.0, 0.0)
         lam = rho * w_pair  # [S, S] contribution for (i above j)
         hessian = rho * (1.0 - rho) * w_pair
@@ -145,6 +190,47 @@ def _lambda_grad_sampled(
         d_j = disc[j]
         delta = (jnp.abs(gains[:, None] - g_j)
                  * jnp.abs(disc[:, None] - d_j) / idcg_row[:, None])
+        w_pair = jnp.where(valid, delta, 0.0)
+    elif scheme == "map":
+        # MAP delta on sampled pairs: the same MAPStats prefix scan
+        # (rank_obj.cu:474 GetMAPStats) segmented over the one global
+        # prediction sort — groups are contiguous blocks in sorted layout,
+        # so within-group inclusive cumsums are cumsum minus the value
+        # just before each block start
+        rel = (label > 0).astype(margin.dtype)
+        rel_sorted = rel[order]
+
+        def segcum(x):
+            cs = jnp.cumsum(x)
+            base = jnp.where(group_start > 0,
+                             cs[jnp.maximum(group_start - 1, 0)], 0.0)
+            return cs - base
+
+        hits_s = segcum(rel_sorted)
+        p_loc = (jnp.arange(n) - group_start).astype(margin.dtype) + 1.0
+        acc1_s = segcum(rel_sorted * hits_s / p_loc)
+        acc2_s = segcum(rel_sorted * (hits_s - 1.0) / p_loc)
+        acc3_s = segcum(rel_sorted * (hits_s + 1.0) / p_loc)
+        total = jax.ops.segment_sum(rel, group_of,
+                                    num_segments=n_groups)[group_of]  # [n]
+
+        r_i = rank[:, None]
+        r_j = rank[j]
+        a = jnp.minimum(r_i, r_j)
+        b = jnp.maximum(r_i, r_j)
+        st = group_start[:, None]
+
+        def at(arr, local_idx):  # sorted-layout gather; local -1 -> 0
+            gi = st + jnp.clip(local_idx, 0, None)
+            return jnp.where(local_idx >= 0,
+                             arr[jnp.clip(gi, 0, n - 1)], 0.0)
+
+        rel_i = rel[:, None]
+        rel_j = rel[j]
+        lab_a = jnp.where(r_i <= r_j, rel_i, rel_j)
+        lab_b = jnp.where(r_i <= r_j, rel_j, rel_i)
+        delta = _map_pair_delta(at, hits_s, acc1_s, acc2_s, acc3_s, a, b,
+                                lab_a, lab_b, total[:, None])
         w_pair = jnp.where(valid, delta, 0.0)
     else:
         w_pair = jnp.where(valid, 1.0, 0.0)
