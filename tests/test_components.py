@@ -638,3 +638,27 @@ def test_chunk_backed_model_paths():
         b2 = xgb.Booster(model_file=fp)
         np.testing.assert_allclose(b2.predict(xgb.DMatrix(X)), p,
                                    rtol=1e-5, atol=1e-6)
+
+
+def test_feature_names_from_any_cache_and_fmap(tmp_path):
+    """Names must resolve from ANY cached matrix (not just the first
+    registered) and an fmap file must actually be honored (ADVICE r3)."""
+    rng = np.random.RandomState(0)
+    X = rng.randn(300, 3).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    d_unnamed = xgb.DMatrix(X, label=y)  # registered FIRST, no names
+    d_named = xgb.DMatrix(X, label=y, feature_names=["aa", "bb", "cc"])
+    bst = xgb.Booster({"objective": "binary:logistic", "max_depth": 2},
+                      [d_unnamed, d_named])
+    for i in range(3):
+        bst.update(d_named, i)
+    assert set(bst.get_score()) <= {"aa", "bb", "cc"}
+    mj = bst.save_json()
+    assert mj["learner"]["feature_names"] == ["aa", "bb", "cc"]
+    # fmap file overrides
+    fmap = tmp_path / "feat.map"
+    fmap.write_text("0 alpha q\n1 beta q\n2 gamma q\n")
+    assert set(bst.get_score(fmap=str(fmap))) <= {"alpha", "beta", "gamma"}
+    h = bst.get_split_value_histogram("beta", fmap=str(fmap),
+                                      as_pandas=False)
+    assert h.shape[1] == 2

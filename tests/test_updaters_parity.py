@@ -1,0 +1,100 @@
+"""End-to-end updater parity: hist / exact / approx must agree on
+realistic data — the oracle the reference applies to its updaters
+(tests/python/test_updaters.py hypothesis strategies: same data, different
+tree_method, near-equal quality; exact is the greedy ground truth).
+
+Sweeps depth/bins/sampling like the reference's strategy grids, with
+AUC-parity and structural-agreement assertions.
+"""
+
+import numpy as np
+import pytest
+
+import xgboost_tpu as xgb
+from xgboost_tpu.metric import create_metric
+
+
+def _data(n=6000, f=10, seed=0, informative=4):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f).astype(np.float32)
+    w = np.zeros(f)
+    w[:informative] = rng.randn(informative) * 1.5
+    y = ((X @ w) + 0.5 * rng.randn(n) > 0).astype(np.float32)
+    return X, y
+
+
+def _train_auc(X, y, method, extra=None, rounds=12):
+    params = {"objective": "binary:logistic", "tree_method": method,
+              "max_depth": 4, "eta": 0.3, "seed": 7}
+    params.update(extra or {})
+    n_tr = int(len(X) * 0.8)
+    d = xgb.DMatrix(X[:n_tr], label=y[:n_tr])
+    bst = xgb.train(params, d, rounds, verbose_eval=False)
+    pred = bst.predict(xgb.DMatrix(X[n_tr:]))
+    return bst, float(create_metric("auc").evaluate(pred, y[n_tr:]))
+
+
+@pytest.mark.parametrize("depth,max_bin", [(3, 32), (4, 256), (6, 64)])
+def test_hist_exact_approx_auc_parity(depth, max_bin):
+    """Same data, all three methods: test AUC within a small band of each
+    other (the reference asserts near-equal eval histories across
+    updaters)."""
+    X, y = _data(seed=depth * 31 + max_bin)
+    aucs = {}
+    for method in ("hist", "exact", "approx"):
+        _, aucs[method] = _train_auc(
+            X, y, method, {"max_depth": depth, "max_bin": max_bin})
+    lo, hi = min(aucs.values()), max(aucs.values())
+    assert lo > 0.85, aucs
+    assert hi - lo < 0.02, aucs
+
+
+def test_exact_is_structural_superset_at_coarse_bins():
+    """At coarse quantization, exact (one bin per distinct value) must be
+    at least as good as hist on TRAIN loss — it has every candidate
+    threshold hist has, plus more."""
+    X, y = _data(n=3000, f=6, seed=5)
+    d = xgb.DMatrix(X, label=y)
+    out = {}
+    for method, mb in (("hist", 16), ("exact", 256)):
+        res = {}
+        xgb.train({"objective": "binary:logistic", "tree_method": method,
+                   "max_bin": mb, "max_depth": 4, "eta": 0.3, "seed": 1,
+                   "eval_metric": "logloss"},
+                  d, 10, evals=[(d, "t")], evals_result=res,
+                  verbose_eval=False)
+        out[method] = res["t"]["logloss"][-1]
+    assert out["exact"] <= out["hist"] + 1e-3, out
+
+
+@pytest.mark.parametrize("extra", [
+    {"subsample": 0.7},
+    {"colsample_bytree": 0.6},
+    {"min_child_weight": 5.0},
+    {"reg_lambda": 5.0, "gamma": 0.5},
+])
+def test_parity_under_regularization_sweeps(extra):
+    X, y = _data(n=4000, f=8, seed=hash(str(sorted(extra))) % 1000)
+    a = {}
+    for method in ("hist", "approx"):
+        _, a[method] = _train_auc(X, y, method, extra)
+    assert min(a.values()) > 0.8, a
+    assert abs(a["hist"] - a["approx"]) < 0.03, a
+
+
+def test_first_tree_identical_hist_vs_approx_on_uniform_hessians():
+    """Round 0 gradients have constant hessians for squared error, so the
+    hessian-weighted re-sketch equals the unweighted sketch and the FIRST
+    trees of hist and approx must split identically."""
+    X, y0 = _data(n=2500, f=5, seed=9)
+    y = (X[:, 0] * 2 - X[:, 1] + 0.1 * np.random.RandomState(9).randn(2500)
+         ).astype(np.float32)
+    cfg = {"objective": "reg:squarederror", "max_depth": 3, "max_bin": 64,
+           "eta": 1.0, "seed": 3}
+    trees = {}
+    for method in ("hist", "approx"):
+        d = xgb.DMatrix(X, label=y)
+        bst = xgb.train(dict(cfg, tree_method=method), d, 1,
+                        verbose_eval=False)
+        trees[method] = bst.get_dump(with_stats=False)[0]
+    assert trees["hist"] == trees["approx"]

@@ -338,7 +338,13 @@ class DMatrix:
         reference likewise validates categories, common/categorical.h
         InvalidCat checks)."""
         for f in cat:
-            col = self.data[:, f]
+            if self._sparse is not None and self._data is None:
+                # CSR-backed: read the column's stored values directly —
+                # touching .data would densify the whole matrix and defeat
+                # the sparse ingestion path
+                col = self._sparse.column_values(f)
+            else:
+                col = self.data[:, f]
             valid = col[~np.isnan(col)]
             if valid.size == 0:
                 continue

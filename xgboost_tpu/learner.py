@@ -543,12 +543,7 @@ class Booster:
         # feature metadata: live training data wins, else whatever a loaded
         # model carried (so load -> save preserves names, like reference
         # LearnerIO)
-        fn = list(getattr(self, "_loaded_feature_names", []) or [])
-        ft = list(getattr(self, "_loaded_feature_types", []) or [])
-        for d in self._cache_refs.values():
-            fn = d.info.feature_names or fn
-            ft = d.info.feature_types or ft
-            break
+        fn, ft = self._feature_meta()
         learner = {
             "feature_names": list(fn),
             "feature_types": list(ft),
@@ -685,10 +680,7 @@ class Booster:
         read directly). Categorical-split features raise like the
         reference."""
         self._configure()
-        names = list(getattr(self, "_loaded_feature_names", []) or [])
-        for d in self._cache_refs.values():
-            names = d.feature_names or names
-            break
+        names = self._parse_fmap(fmap) or self._feature_meta()[0]
         try:
             fidx = int(feature[1:]) if (not names and feature.startswith("f")
                                         and feature[1:].isdigit()) \
@@ -723,17 +715,37 @@ class Booster:
                 pass
         return nph
 
+    def _feature_meta(self):
+        """(feature_names, feature_types) from the first cached matrix
+        carrying ANY feature metadata — both fields from the SAME source so
+        they always describe one schema — falling back to what a loaded
+        model carried."""
+        for d in self._cache_refs.values():
+            if d.feature_names or getattr(d.info, "feature_types", None):
+                return (list(d.feature_names or []),
+                        list(d.info.feature_types or []))
+        return (list(getattr(self, "_loaded_feature_names", []) or []),
+                list(getattr(self, "_loaded_feature_types", []) or []))
+
+    @staticmethod
+    def _parse_fmap(fmap: str) -> Optional[List[str]]:
+        """featmap.txt parsing ('<id> <name> <type>' per line — reference
+        core.py FeatureMap); None when the file is absent/empty."""
+        if not fmap or not os.path.exists(fmap):
+            return None
+        names: Dict[int, str] = {}
+        with open(fmap) as f:
+            for line in f:
+                ps = line.split()
+                if len(ps) >= 2:
+                    names[int(ps[0])] = ps[1]
+        if not names:
+            return None
+        return [names.get(i, f"f{i}") for i in range(max(names) + 1)]
+
     def get_dump(self, fmap: str = "", with_stats: bool = False, dump_format: str = "text") -> List[str]:
         self._configure()
-        names = None
-        if fmap and os.path.exists(fmap):
-            names = {}
-            with open(fmap) as f:
-                for line in f:
-                    ps = line.split()
-                    if len(ps) >= 2:
-                        names[int(ps[0])] = ps[1]
-            names = [names.get(i, f"f{i}") for i in range(max(names) + 1)] if names else None
+        names = self._parse_fmap(fmap)
         out = []
         for t in self._gbm.model.trees:
             if dump_format == "json":
@@ -766,10 +778,7 @@ class Booster:
                 weight[f] = weight.get(f, 0.0) + 1.0
                 gain[f] = gain.get(f, 0.0) + float(g)
                 cover[f] = cover.get(f, 0.0) + float(c)
-        names = list(getattr(self, "_loaded_feature_names", []) or []) or None
-        for d in self._cache_refs.values():
-            names = d.feature_names or names
-            break
+        names = self._parse_fmap(fmap) or self._feature_meta()[0] or None
 
         def nm(f: int) -> str:
             return names[f] if names and f < len(names) else f"f{f}"

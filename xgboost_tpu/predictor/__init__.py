@@ -359,13 +359,27 @@ def predict_margin(
             )  # [n, G]
             return base_margin + margins
         except Exception as e:
-            # compile-time blowups (scoped-vmem OOM, Mosaic rejects) are
-            # permanent for this shape: remember them. Transient runtime
-            # errors still fall back this call but may retry later.
-            msg = str(e).lower()
-            if any(t in msg for t in ("vmem", "mosaic", "compile")):
-                _pallas_pred_broken.add(
-                    (T, Np, forest.max_depth, X.shape[1], forest.n_groups))
+            # compiler-layer failures (scoped-vmem OOM, Mosaic rejects) are
+            # permanent for this shape: recognized by exception TYPE, or by
+            # the two compiler-specific substrings for errors the runtime
+            # re-wraps. Anything else is treated as transient — it falls
+            # back this call but may retry later. Both outcomes are logged
+            # so the perf cliff is observable.
+            from ..utils import console_logger
+
+            permanent = type(e).__name__ in (
+                "XlaRuntimeError", "JaxRuntimeError", "NotImplementedError",
+                "MosaicError", "InternalError", "ResourceExhaustedError",
+            ) or any(t in str(e).lower() for t in ("vmem", "mosaic"))
+            if permanent:
+                key = (T, Np, forest.max_depth, X.shape[1], forest.n_groups)
+                _pallas_pred_broken.add(key)
+                console_logger.warning(
+                    f"pallas predictor disabled for forest shape {key}: "
+                    f"{str(e)[:200]}")
+            else:
+                console_logger.warning(
+                    f"pallas predictor fell back (transient): {str(e)[:200]}")
     return _predict_margin_kernel(
         jnp.asarray(X, jnp.float32),
         forest.left, forest.right, forest.feature, forest.cond,
