@@ -35,9 +35,10 @@ class _PendingTree:
 
     __slots__ = ("keep", "feature", "split_bin", "split_cond", "default_left",
                  "node_weight", "loss_chg", "node_h", "leaf_value", "eta",
-                 "max_depth")
+                 "max_depth", "cat_set", "cat_mask")
 
-    def __init__(self, g: GrownTree, eta: float, max_depth: int):
+    def __init__(self, g: GrownTree, eta: float, max_depth: int,
+                 cat_mask=None):
         self.keep = g.keep
         self.feature = g.feature
         self.split_bin = g.split_bin
@@ -49,6 +50,10 @@ class _PendingTree:
         self.leaf_value = g.leaf_value
         self.eta = eta
         self.max_depth = max_depth
+        # categorical metadata ([max_nodes, B] right-going sets + [F] bool
+        # feature mask); None for pure-numerical trees
+        self.cat_set = g.cat_set if cat_mask is not None else None
+        self.cat_mask = cat_mask
 
 
 class _PendingChunk:
@@ -297,6 +302,20 @@ def _materialize_pending(pending: List[_PendingTree]) -> List[RegTree]:
         return np.asarray(jnp.stack(arrs))
 
     stacked = {f: stack(f) for f in fields}
+    cat_ix = [i for i, t in enumerate(pending) if t.cat_mask is not None]
+    cat_sets = {}
+    if cat_ix:
+        # one bulk transfer for every categorical set, like the scalar
+        # fields: pad to the common [Nmax, Bmax] then stack
+        Bmax = max(pending[i].cat_set.shape[1] for i in cat_ix)
+        padded = [
+            jnp.pad(pending[i].cat_set,
+                    ((0, Nmax - pending[i].cat_set.shape[0]),
+                     (0, Bmax - pending[i].cat_set.shape[1])))
+            for i in cat_ix
+        ]
+        host_sets = np.asarray(jnp.stack(padded))
+        cat_sets = {i: host_sets[j] for j, i in enumerate(cat_ix)}
     out = []
     for i, t in enumerate(pending):
         m = sizes[i]
@@ -306,6 +325,8 @@ def _materialize_pending(pending: List[_PendingTree]) -> List[RegTree]:
             stacked["node_weight"][i][:m], stacked["loss_chg"][i][:m],
             stacked["node_h"][i][:m], eta=t.eta,
             split_bin=stacked["split_bin"][i][:m],
+            cat_features=t.cat_mask,
+            cat_set=cat_sets.get(i)[:m] if i in cat_sets else None,
         ))
     return out
 
@@ -336,8 +357,8 @@ class GBTreeModel:
         self._stacked = None
 
     def add_device(self, grown: GrownTree, eta: float, group: int,
-                   max_depth: int) -> None:
-        self._entries.append(_PendingTree(grown, eta, max_depth))
+                   max_depth: int, cat_mask=None) -> None:
+        self._entries.append(_PendingTree(grown, eta, max_depth, cat_mask))
         self.tree_info.append(group)
         self._stacked = None
 
@@ -407,8 +428,13 @@ class GBTreeModel:
         the incremental prediction-cache catch-up nor per-round DART
         repredicts may trigger host syncs mid-training (gbtree.cc:519)."""
         ents = self._entries[lo:hi]
-        if ents and all(isinstance(e, (_PendingTree, _ChunkRef))
-                        for e in ents):
+        if ents and all(
+            isinstance(e, (_PendingTree, _ChunkRef))
+            and getattr(e, "cat_mask", None) is None
+            for e in ents
+        ):
+            # (categorical pending trees fall through to host
+            # materialization — their bitset packing lives in RegTree)
             return _stack_device_mixed(ents, self.tree_info[lo:hi],
                                        self.n_groups)
         if ents and all(isinstance(e, _PendingAllocTree) for e in ents):
@@ -428,6 +454,23 @@ class GBTreeModel:
             for t in range(r * per_round, min((r + 1) * per_round, len(trees))):
                 out.add(trees[t], self.tree_info[t])
         return out
+
+
+def _cat_cfg(cfg: GrowParams, binned, tp) -> Tuple[GrowParams, Any]:
+    """Apply the one-hot vs optimal-partition gate (reference UseOneHot,
+    evaluate_splits.h: one-hot when n_cats < max_cat_to_onehot) to a grow
+    config. Single home for the rule so the fused and lossguide growers
+    cannot diverge. Returns (cfg, cat_mask or None)."""
+    cats = tuple(getattr(binned, "categorical", ()))
+    if not cats:
+        return cfg, None
+    counts = tuple(getattr(binned, "cat_counts", ())) or (0,) * len(cats)
+    onehot_f = tuple(f for f, c in zip(cats, counts)
+                     if c < tp.max_cat_to_onehot)
+    part_f = tuple(f for f, c in zip(cats, counts)
+                   if c >= tp.max_cat_to_onehot)
+    cfg = _dc.replace(cfg, categorical=onehot_f, cat_partition=part_f)
+    return cfg, cfg.cat_mask_np(binned.n_features)
 
 
 def round_seed_py(seed: int, iteration: int, k: int = 0,
@@ -822,8 +865,8 @@ class GBTree:
         cats = tuple(getattr(binned, "categorical", ()))
         lossguide_pol = tp.grow_policy == "lossguide"
         # fast path: fused per-level kernels, device-resident trees, zero
-        # host syncs per round (depthwise, numerical; mesh-aware)
-        if not lossguide_pol and not cats:
+        # host syncs per round (depthwise incl. categorical; mesh-aware)
+        if not lossguide_pol:
             return self._boost_fused(binned, grad, hess, iteration,
                                      margin_cache, feature_weights)
         if getattr(binned, "is_paged", False):
@@ -832,14 +875,7 @@ class GBTree:
                 "training only (reference external memory has the same "
                 "hist-only restriction)"
             )
-        if cats:
-            # one-hot vs optimal-partition gate (reference UseOneHot,
-            # evaluate_splits.h: one-hot when n_cats < max_cat_to_onehot)
-            counts = tuple(getattr(binned, "cat_counts", ())) or (0,) * len(cats)
-            onehot = tuple(f for f, c in zip(cats, counts) if c < tp.max_cat_to_onehot)
-            part = tuple(f for f, c in zip(cats, counts) if c >= tp.max_cat_to_onehot)
-            cfg = _dc.replace(cfg, categorical=onehot, cat_partition=part)
-        cat_mask = cfg.cat_mask_np(binned.n_features) if cfg.has_categorical else None
+        cfg, cat_mask = _cat_cfg(cfg, binned, tp)
         cuts = binned.cuts
         cut_vals = jnp.asarray(cuts.values)
         lossguide = tp.grow_policy == "lossguide"
@@ -1025,9 +1061,15 @@ class GBTree:
         from ..parallel.mesh import current_mesh, shard_rows
 
         tp = self.train_param
-        cfg = self._grow_params()
+        cfg, cat_mask = _cat_cfg(self._grow_params(), binned, tp)
         mesh = current_mesh()
         use_mesh = mesh is not None and mesh.devices.size > 1
+        if use_mesh and cfg.has_categorical:
+            raise NotImplementedError(
+                "categorical training under a mesh is not supported yet "
+                "(the distributed sketch's categorical identity-cut path "
+                "is untested); train single-device or drop feature_types"
+            )
         n = binned.n_rows
         cut_vals = jnp.asarray(binned.cuts.values)
         fw = (jnp.asarray(feature_weights)
@@ -1037,6 +1079,11 @@ class GBTree:
             raise NotImplementedError(
                 "external-memory + mesh training is not supported yet; "
                 "shard rows across processes instead (docs/distributed.md)"
+            )
+        if paged and cfg.has_categorical:
+            raise NotImplementedError(
+                "external-memory matrices support numerical training only "
+                "(reference external memory has the same restriction)"
             )
         if paged:
             from ..tree.grow_fused import grow_tree_fused_paged
@@ -1085,7 +1132,8 @@ class GBTree:
                     round_seed_py(tp.seed, iteration, k, ptree)
                 )
                 grown = grow_one(g, h, key)
-                self.model.add_device(grown, tp.eta, k, tp.max_depth)
+                self.model.add_device(grown, tp.eta, k, tp.max_depth,
+                                      cat_mask)
                 new_trees.append(grown)
                 if margin_cache is not None:
                     delta = grown.delta[:n]

@@ -67,6 +67,7 @@ class GrownTree(NamedTuple):
     loss_chg: jax.Array  # f32
     leaf_value: jax.Array  # f32 — eta-applied governing leaf value per node
     delta: jax.Array  # f32 [n_padded] margin increment (training rows)
+    cat_set: jax.Array  # bool [max_nodes, B] right-going sets ([1,1] if none)
 
 
 class _HeapState(NamedTuple):
@@ -85,7 +86,8 @@ class _HeapState(NamedTuple):
     lo_b: jax.Array  # [max_nodes] or [1] when unconstrained
     up_b: jax.Array
     used: jax.Array  # [max_nodes, F] or [1, F]
-    ptab: jax.Array  # [K, 4] previous level's decisions
+    ptab: jax.Array  # [K, 4] (or [K, 5+B] with categoricals) decisions
+    cat_set: jax.Array  # [max_nodes, B] right-going sets, or [1, 1]
 
 
 def pad_rows(n: int) -> int:
@@ -109,12 +111,13 @@ def _constraint_consts(cfg: GrowParams, F: int):
     return mono_j, gmask
 
 
-def _init_state(cfg: GrowParams, F: int, G0, H0) -> _HeapState:
+def _init_state(cfg: GrowParams, F: int, G0, H0, B: int = 0) -> _HeapState:
     max_nodes = cfg.max_nodes
     p = cfg.split
     z = lambda dt: jnp.zeros((max_nodes,), dt)  # noqa: E731
     nb = max_nodes if cfg.has_monotone else 1
     nu = max_nodes if cfg.has_interaction else 1
+    cat = cfg.has_categorical
     return _HeapState(
         is_split=z(bool), feature=z(jnp.int32), split_bin=z(jnp.int32),
         split_cond=z(jnp.float32), default_left=z(bool),
@@ -124,7 +127,8 @@ def _init_state(cfg: GrowParams, F: int, G0, H0) -> _HeapState:
         loss_chg=z(jnp.float32),
         lo_b=jnp.full((nb,), -_INF), up_b=jnp.full((nb,), _INF),
         used=jnp.zeros((nu, F), bool),
-        ptab=jnp.zeros((1, 4), jnp.float32),
+        ptab=jnp.zeros((1, 5 + B if cat else 4), jnp.float32),
+        cat_set=jnp.zeros((max_nodes if cat else 1, B if cat else 1), bool),
     )
 
 
@@ -187,11 +191,16 @@ def _level_update(
         node_used = jax.lax.dynamic_slice_in_dim(st.used, off, K, axis=0)
         node_fmask = node_fmask & interaction_allowed(node_used, gmask)
 
+    if cfg.has_categorical:
+        _, cat_j, catp_j = cfg.cat_masks_jnp(F)
+    else:
+        cat_j = catp_j = None
     dec = eval_splits(
         hist, Gtot, Htot, p, node_fmask, B,
         mono=mono_j if cfg.has_monotone else None,
         node_lo=node_lo if cfg.has_monotone else None,
         node_up=node_up if cfg.has_monotone else None,
+        cat_feats=cat_j, cat_part=catp_j,
     )
     can_split = (dec.loss > RT_EPS) & (Htot > 0.0)
     GLb, HLb = dec.GL, dec.HL
@@ -240,11 +249,21 @@ def _level_update(
         ],
         axis=1,
     )  # [K, 4]
+    cat_set = st.cat_set
+    if cfg.has_categorical:
+        any_mask = jnp.asarray(cfg.cat_mask_np(F))
+        is_cat = any_mask[dec.f] & can_split  # [K]
+        win_set = dec.cat_set & is_cat[:, None]  # [K, B]
+        cat_set = cat_set.at[slots].set(win_set)
+        # widen the decision table: col 4 = is_cat, cols 5: = right set
+        ptab = jnp.concatenate(
+            [ptab, is_cat.astype(jnp.float32)[:, None],
+             win_set.astype(jnp.float32)], axis=1)  # [K, 5 + B]
     return _HeapState(
         is_split=is_split, feature=feature, split_bin=split_bin,
         split_cond=split_cond, default_left=default_left,
         node_g=node_g, node_h=node_h, node_w=node_w, loss_chg=loss_chg,
-        lo_b=lo_b, up_b=up_b, used=used, ptab=ptab,
+        lo_b=lo_b, up_b=up_b, used=used, ptab=ptab, cat_set=cat_set,
     )
 
 
@@ -308,7 +327,6 @@ def grow_tree_fused(
     p = cfg.split
     max_depth = cfg.max_depth
     max_nodes = cfg.max_nodes
-    assert not cfg.has_categorical, "fused grower is numerical-only"
     pallas = _pallas_flag(cfg)
 
     k_sub, k_ctree, k_level = jax.random.split(key, 3)
@@ -331,7 +349,7 @@ def grow_tree_fused(
     if cfg.axis_name is not None:
         G0 = jax.lax.psum(G0, cfg.axis_name)
         H0 = jax.lax.psum(H0, cfg.axis_name)
-    st = _init_state(cfg, F, G0, H0)
+    st = _init_state(cfg, F, G0, H0, B)
 
     pos = jnp.zeros((n, 1), jnp.int32)
     for d in range(max_depth):
@@ -360,6 +378,7 @@ def grow_tree_fused(
         split_cond=st.split_cond, default_left=st.default_left,
         node_g=st.node_g, node_h=st.node_h, node_weight=st.node_w,
         loss_chg=st.loss_chg, leaf_value=leaf_value, delta=delta,
+        cat_set=st.cat_set,
     )
 
 
@@ -492,4 +511,5 @@ def grow_tree_fused_paged(
         split_cond=st.split_cond, default_left=st.default_left,
         node_g=st.node_g, node_h=st.node_h, node_weight=st.node_w,
         loss_chg=st.loss_chg, leaf_value=leaf_value, delta=delta,
+        cat_set=st.cat_set,
     )

@@ -128,8 +128,13 @@ def _split_hilo(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
 def _partition_tile(pos, binsb, ptab_ref, *, Kp: int, F: int, B: int,
                     prev_offset: int):
     """Route a tile's rows through the previous level's decision table
-    (shared by both level kernels). ``pos``/``binsb`` are values in VMEM."""
+    (shared by both level kernels). ``pos``/``binsb`` are values in VMEM.
+    Table layout: ``[Kp, 4]`` numerical (is_split, feature, bin,
+    default_left), or ``[Kp, 5 + B]`` when categorical features exist —
+    column 4 flags a categorical node and columns 5: carry its RIGHT-going
+    category set (evaluate_splits.h Decision: stored sets go right)."""
     Tr = binsb.shape[0]
+    W = ptab_ref.shape[-1]
     lp = pos - prev_offset
     iota_kp = jax.lax.broadcasted_iota(jnp.int32, (Tr, Kp), 1)
     ohp = (lp == iota_kp).astype(jnp.float32)
@@ -138,7 +143,7 @@ def _partition_tile(pos, binsb, ptab_ref, *, Kp: int, F: int, B: int,
         ohp, ptab_ref[:, :], (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
         precision=jax.lax.Precision.HIGHEST,
-    )  # [Tr, 4] = (is_split, feature, bin, default_left)
+    )  # [Tr, W]
     isp_of = dec[:, 0:1]
     f_of = dec[:, 1:2].astype(jnp.int32)
     b_of = dec[:, 2:3]
@@ -149,7 +154,17 @@ def _partition_tile(pos, binsb, ptab_ref, *, Kp: int, F: int, B: int,
     # arithmetic (not boolean) masks: Mosaic rejects i1 vectors at lane 1
     missing = (bv >= B).astype(jnp.float32)
     leq = (bv <= b_of).astype(jnp.float32)
-    goleft = missing * dl_of + (1.0 - missing) * leq
+    if W > 4:
+        isc_of = dec[:, 4:5]
+        setrow = dec[:, 5:]  # [Tr, B] the node's right-going set
+        iota_b = jax.lax.broadcasted_iota(jnp.int32, (Tr, W - 5), 1)
+        member = jnp.sum(
+            (bv == iota_b.astype(jnp.float32)).astype(jnp.float32) * setrow,
+            axis=1, keepdims=True)
+        present_left = isc_of * (1.0 - member) + (1.0 - isc_of) * leq
+    else:
+        present_left = leq
+    goleft = missing * dl_of + (1.0 - missing) * present_left
     inb = (lp >= 0).astype(jnp.float32) * (lp < Kp).astype(jnp.float32)
     goes = inb * isp_of
     child = 2 * pos + 1 + (goleft < 0.5).astype(jnp.int32)
@@ -216,6 +231,7 @@ def _fused_level_pallas(bins, pos, gh, ptab, *, K, Kp, B, d, tr=TR):
     assert n % tr == 0, f"rows {n} not padded to {tr}"
     prev_offset = (1 << (d - 1)) - 1 if d > 0 else 0
     offset = (1 << d) - 1
+    W = ptab.shape[1]
     kern = functools.partial(
         _level_kernel, K=K, Kp=Kp, F=F, B=B,
         prev_offset=prev_offset, offset=offset,
@@ -227,7 +243,7 @@ def _fused_level_pallas(bins, pos, gh, ptab, *, K, Kp, B, d, tr=TR):
             pl.BlockSpec((tr, F), lambda c: (c, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((tr, 1), lambda c: (c, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((tr, 2), lambda c: (c, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((max(Kp, 1), 4), lambda c: (0, 0),
+            pl.BlockSpec((max(Kp, 1), W), lambda c: (0, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=[
@@ -284,6 +300,7 @@ def _hoisted_level_pallas(bins, onehot, pos, gh, ptab, *, K, Kp, B, d,
     assert n % tr == 0, f"rows {n} not padded to {tr}"
     prev_offset = (1 << (d - 1)) - 1 if d > 0 else 0
     offset = (1 << d) - 1
+    W = ptab.shape[1]
     kern = functools.partial(
         _hoisted_kernel, K=K, Kp=Kp, F=F, B=B,
         prev_offset=prev_offset, offset=offset,
@@ -296,7 +313,7 @@ def _hoisted_level_pallas(bins, onehot, pos, gh, ptab, *, K, Kp, B, d,
             pl.BlockSpec((tr, Q), lambda c: (c, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((tr, 1), lambda c: (c, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((tr, 2), lambda c: (c, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((max(Kp, 1), 4), lambda c: (0, 0),
+            pl.BlockSpec((max(Kp, 1), W), lambda c: (0, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=[
@@ -316,21 +333,31 @@ def _hoisted_level_pallas(bins, onehot, pos, gh, ptab, *, K, Kp, B, d,
 
 def partition_apply_xla(bins, pos, ptab, *, Kp: int, B: int, d: int):
     """Route rows through level ``d-1``'s decisions (XLA, gather-free where
-    it matters: the per-node table lookup is a one-hot matmul)."""
+    it matters: the per-node table lookup is a one-hot matmul). Handles
+    both table layouts — see ``_partition_tile``."""
     prev_offset = (1 << (d - 1)) - 1 if d > 0 else 0
+    W = ptab.shape[1]
     lp = pos[:, 0] - prev_offset  # [n]
     ohp = jax.nn.one_hot(jnp.where((lp >= 0) & (lp < Kp), lp, Kp),
                          Kp + 1, dtype=jnp.float32)[:, :Kp]  # [n, Kp]
     dec = jax.lax.dot_general(ohp, ptab, (((1,), (0,)), ((), ())),
                               preferred_element_type=jnp.float32,
-                              precision=jax.lax.Precision.HIGHEST)  # [n, 4]
+                              precision=jax.lax.Precision.HIGHEST)  # [n, W]
     isp_of = dec[:, 0]
     f_of = dec[:, 1].astype(jnp.int32)
     b_of = dec[:, 2]
     dl_of = dec[:, 3]
     bv = jnp.take_along_axis(bins, f_of[:, None], axis=1)[:, 0].astype(jnp.float32)
     missing = bv >= B
-    goleft = jnp.where(missing, dl_of > 0.5, bv <= b_of)
+    present_left = bv <= b_of
+    if W > 4:
+        isc_of = dec[:, 4] > 0.5
+        setrow = dec[:, 5:]  # [n, B]
+        member = jnp.take_along_axis(
+            setrow, jnp.minimum(bv, float(B - 1)).astype(jnp.int32)[:, None],
+            axis=1)[:, 0] > 0.5
+        present_left = jnp.where(isc_of, ~member, present_left)
+    goleft = jnp.where(missing, dl_of > 0.5, present_left)
     inb = (lp >= 0) & (lp < Kp)
     goes = inb & (isp_of > 0.5)
     p = pos[:, 0]
