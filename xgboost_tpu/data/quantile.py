@@ -306,11 +306,13 @@ class BinnedMatrix:
         (all-missing, inert) to a multiple of tile x devices."""
         if self._fused_mesh is not None and self._fused_mesh[0] == id(mesh):
             return self._fused_mesh[1], self._fused_mesh[2]
-        from ..parallel.mesh import shard_rows
+        from ..parallel.mesh import local_device_count, shard_rows
         from ..tree.grow_fused import TR
 
-        D = mesh.devices.size
-        unit = TR * D
+        # pad THIS process's rows against its own device count: every
+        # process's local block is then the same fraction of the global
+        # array (multi-process: each process holds its own row slice)
+        unit = TR * local_device_count(mesh)
         n_pad = -(-self.n_rows // unit) * unit
         shards = shard_rows(self._pad_narrow(n_pad), mesh)
         self._fused_mesh = (id(mesh), shards, n_pad)
@@ -321,13 +323,16 @@ class BinnedMatrix:
         (bin id == max_bin) and carry zero gradients at use sites — the
         fixed-shape analog of the reference's empty-worker handling
         (dask.py:914)."""
-        from ..parallel.mesh import pad_to_multiple, shard_rows
+        from ..parallel.mesh import (
+            local_device_count,
+            pad_to_multiple,
+            shard_rows,
+        )
 
         if self._sharded is not None and self._sharded[0] == id(mesh):
             return self._sharded[1], self._sharded[2]
-        D = mesh.devices.size
         n = self.n_rows
-        n_pad = pad_to_multiple(n, D)
+        n_pad = pad_to_multiple(n, local_device_count(mesh))
         bins = self.bins
         if n_pad != n:
             pad = jnp.full((n_pad - n, self.n_features), self.cuts.missing_bin,

@@ -862,6 +862,13 @@ class GBTree:
         cfg = self._grow_params()
         mesh = current_mesh()
         use_mesh = mesh is not None and mesh.devices.size > 1
+        if use_mesh and jax.process_count() > 1:
+            # covers EVERY per-round branch (fused, lossguide, legacy):
+            # per-round margin deltas stay device-sharded across processes
+            raise NotImplementedError(
+                "multi-process training runs through update_many (scan) "
+                "chunks; see docs/distributed.md"
+            )
         cats = tuple(getattr(binned, "categorical", ()))
         lossguide_pol = tp.grow_policy == "lossguide"
         # fast path: fused per-level kernels, device-resident trees, zero
@@ -1227,6 +1234,11 @@ class GBTree:
                 shard_rows(m_pad, mesh), iters, cut_vals, eta, gamma, fw,
                 jnp.uint32(seed_base), n, cfg,
             )
+            from ..parallel.mesh import local_rows
+
+            # back to THIS process's rows (identity single-process): the
+            # margin cache, evals, and predictions are process-local
+            m_pad = local_rows(m_pad)
         else:
             m_pad, stacked = _scan_rounds_impl(
                 binsf, label, weight_j, m_pad, iters, cut_vals, eta, gamma,

@@ -16,6 +16,7 @@ from functools import partial
 from typing import Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
@@ -173,18 +174,52 @@ def distributed_boost_rounds_scan(
     round — the fixed-shape analog of the reference's empty-worker
     handling."""
     from ..gbm.gbtree import _obj_fingerprint
+    from .mesh import local_device_count, replicate
 
+    n_procs = jax.process_count()
+    if n_procs > 1:
+        # the r // d_local shard->process attribution below requires the
+        # mesh device order to be process-major contiguous blocks of equal
+        # size — true for make_mesh(jax.devices()); anything else would
+        # SILENTLY mis-mask padding rows, so verify loudly
+        pidx = [d.process_index for d in mesh.devices.flat]
+        dl = local_device_count(mesh)
+        ok = (len(pidx) == dl * n_procs and all(
+            pidx[i] == i // dl for i in range(len(pidx))))
+        if not ok:
+            raise ValueError(
+                "multi-process mesh must list devices process-major with "
+                f"equal per-process counts; got process order {pidx}"
+            )
+        # per-process real row counts (the validity mask must know where
+        # each PROCESS's padding tail starts — real rows are not a global
+        # prefix under load_row_split ingestion), plus explicit replication
+        # of the small operands: multi-process programs only accept global
+        # arrays
+        from jax.experimental import multihost_utils
+
+        n_arr = jnp.asarray(
+            multihost_utils.process_allgather(np.asarray(n, np.int32)))
+        rep = lambda x: None if x is None else replicate(  # noqa: E731
+            jnp.asarray(x), mesh)
+        iters, cut_values, eta, gamma, feature_weights, seed_base, n_arr = (
+            rep(iters), rep(cut_values), rep(eta), rep(gamma),
+            rep(feature_weights), rep(seed_base), rep(n_arr))
+    else:
+        n_arr = jnp.asarray([n], jnp.int32)
     return _dist_scan_impl(
         bins, label, weight, margin, iters, cut_values, eta, gamma,
-        feature_weights, seed_base, mesh=mesh, obj=obj,
-        obj_fp=_obj_fingerprint(obj), cfg=cfg, n=n,
+        feature_weights, seed_base, n_arr, mesh=mesh, obj=obj,
+        obj_fp=_obj_fingerprint(obj), cfg=cfg,
+        d_local=local_device_count(mesh),
     )
 
 
-@partial(jax.jit, static_argnames=("mesh", "obj", "obj_fp", "cfg", "n"))
+@partial(jax.jit, static_argnames=("mesh", "obj", "obj_fp", "cfg",
+                                   "d_local"))
 def _dist_scan_impl(bins, label, weight, margin, iters, cut_values, eta,
-                    gamma, feature_weights, seed_base, *, mesh, obj, obj_fp,
-                    cfg, n):
+                    gamma, feature_weights, seed_base, n_arr, *, mesh, obj,
+                    obj_fp, cfg, d_local):
     import dataclasses
 
     import jax.numpy as jnp
@@ -197,11 +232,15 @@ def _dist_scan_impl(bins, label, weight, margin, iters, cut_values, eta,
     n_pad, K = margin.shape
     rows_local = n_pad // D
 
-    def shard_fn(bins_s, label_s, weight_s, m_s, fw):
+    def shard_fn(bins_s, label_s, weight_s, m_s, fw, n_a):
         r = jax.lax.axis_index(ROW_AXIS)
-        valid = (r * rows_local
+        # shard r belongs to process r // d_local; its real-row budget is
+        # that process's count, measured within the process's block
+        q = r % d_local
+        n_own = n_a[r // d_local]
+        valid = (q * rows_local
                  + jax.lax.broadcasted_iota(jnp.int32, (rows_local, 1), 0)[:, 0]
-                 ) < n
+                 ) < n_own
         validf = valid.astype(jnp.float32)
 
         def body(m_loc, i):
@@ -238,6 +277,8 @@ def _dist_scan_impl(bins, label, weight, margin, iters, cut_values, eta,
     else:
         in_specs.append(None)
         args.append(None)
+    in_specs.append(P())
+    args.append(n_arr)
     fn = jax.shard_map(
         shard_fn, mesh=mesh, in_specs=tuple(in_specs),
         out_specs=(P(ROW_AXIS, None), tree_specs),

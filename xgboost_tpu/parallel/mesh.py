@@ -86,14 +86,69 @@ def pad_to_multiple(n: int, k: int) -> int:
     return ((n + k - 1) // k) * k
 
 
+def local_device_count(mesh: Mesh) -> int:
+    """Devices of ``mesh`` owned by THIS process (== mesh size when
+    single-process). Row padding is computed per process against this, so
+    every process's local block is the same fraction of the global array."""
+    pi = jax.process_index()
+    return sum(1 for d in mesh.devices.flat if d.process_index == pi)
+
+
+def _put_global(arr, sharding) -> jax.Array:
+    """device_put that also works multi-process: each process supplies its
+    process-local block (or the full array for replicated specs)."""
+    if jax.process_count() > 1:
+        return jax.make_array_from_process_local_data(
+            sharding, np.asarray(arr))
+    return jax.device_put(arr, sharding)
+
+
+def _check_equal_blocks(n_local: int) -> None:
+    """Multi-process row sharding requires every process to contribute the
+    SAME padded block size (global shape inference and the per-shard
+    validity mask both assume it). Fails loudly instead of deadlocking."""
+    from jax.experimental import multihost_utils
+
+    sizes = np.asarray(multihost_utils.process_allgather(
+        np.asarray(n_local, np.int64)))
+    if not (sizes == sizes[0]).all():
+        raise ValueError(
+            "multi-process training requires equal PADDED row blocks per "
+            f"process; got {sizes.tolist()}. Give every process the same "
+            "number of rows (pad the short ones — padded rows are inert)."
+        )
+
+
 def shard_rows(arr: jax.Array, mesh: Mesh) -> jax.Array:
     """Place an array row-sharded over the mesh (rows must divide evenly —
     pad first; padded rows carry zero gradient/hessian so they are inert,
     the fixed-shape analog of the reference's empty-worker handling,
-    dask.py:914)."""
+    dask.py:914). Multi-process: ``arr`` is THIS process's row block (the
+    load_row_split model — each process ingested its own slice) and the
+    global array is their concatenation in process order; all processes
+    must contribute equally-sized padded blocks."""
+    if jax.process_count() > 1:
+        _check_equal_blocks(arr.shape[0])
     spec = P(ROW_AXIS, *([None] * (arr.ndim - 1)))
-    return jax.device_put(arr, NamedSharding(mesh, spec))
+    return _put_global(arr, NamedSharding(mesh, spec))
 
 
 def replicate(arr: jax.Array, mesh: Mesh) -> jax.Array:
-    return jax.device_put(arr, NamedSharding(mesh, P()))
+    """Replicate a (process-identical) array over the whole mesh."""
+    return _put_global(arr, NamedSharding(mesh, P()))
+
+
+def local_rows(arr: jax.Array) -> jax.Array:
+    """THIS process's row block of a row-sharded global array (identity
+    when single-process): the inverse of ``shard_rows``. Used to bring
+    per-row outputs (margins, deltas) back to process-local layout."""
+    if jax.process_count() == 1:
+        return arr
+    shards = sorted(arr.addressable_shards,
+                    key=lambda s: s.index[0].start or 0)
+    import jax.numpy as jnp
+
+    # via host: the shards live committed on DIFFERENT local devices and
+    # cannot be concatenated device-side without explicit transfers
+    return jnp.asarray(
+        np.concatenate([np.asarray(s.data) for s in shards], axis=0))
