@@ -1,0 +1,93 @@
+"""The reference's sketch-accuracy contract, ported as a property oracle
+(tests/cpp/common/test_hist_util.h ValidateCuts/TestRank: each cut's
+weighted rank within max(2.9, 5% of total weight) of the ideal uniform
+rank; cuts strictly increasing; min/max coverage), over the same
+generator (uniform[0,1] + column offset; mt19937-style uniform weights)
+and the same bin/size grids as DenseCutsAccuracyTest{,Weights}
+(test_hist_util.cc:201,216)."""
+
+import numpy as np
+import pytest
+
+import xgboost_tpu as xgb
+
+
+def _gen(num_rows, num_cols, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.uniform(0.0, 1.0, size=(num_rows, num_cols)).astype(np.float32)
+    x += np.arange(num_cols, dtype=np.float32)[None, :]
+    return x
+
+
+def _validate_column(cut_vals, min_val, col, weights, num_bins):
+    """Python twin of ValidateColumn/TestRank (test_hist_util.h:119+)."""
+    order = np.argsort(col, kind="stable")
+    sx = col[order]
+    sw = weights[order]
+    cuts = np.unique(cut_vals)  # fixed-shape padding repeats the last cut
+    # strictly increasing + coverage (ValidateColumn)
+    assert (np.diff(cuts) > 0).all()
+    assert min_val < sx[0] + 1e-5
+    assert sx[-1] <= cuts[-1] + 1e-5
+    if len(cuts) < 2:
+        return
+    total = float(sw.sum())
+    eps = 0.05
+    sum_w, j = 0.0, 0
+    for i in range(len(cuts) - 1):
+        while j < len(sx) and cuts[i] > sx[j]:
+            sum_w += float(sw[j])
+            j += 1
+        expected_rank = (i + 1) * total / len(cuts)
+        acceptable = max(2.9, total * eps)
+        assert abs(expected_rank - sum_w) <= acceptable, (
+            i, expected_rank, sum_w, len(cuts))
+
+
+@pytest.mark.parametrize("num_bins", [2, 16, 256, 512])
+@pytest.mark.parametrize("num_rows", [100, 1000])
+def test_dense_cuts_accuracy(num_bins, num_rows):  # test_hist_util.cc:201
+    F = 5
+    x = _gen(num_rows, F)
+    d = xgb.DMatrix(x)
+    bm = d.get_binned(num_bins)
+    w = np.ones(num_rows, np.float32)
+    for f in range(F):
+        _validate_column(np.asarray(bm.cuts.values[f]),
+                         float(bm.cuts.min_vals[f]), x[:, f], w, num_bins)
+
+
+@pytest.mark.parametrize("num_bins", [2, 16, 256])
+@pytest.mark.parametrize("num_rows", [100, 1000, 1500])
+def test_dense_cuts_accuracy_weighted(num_bins, num_rows):
+    # test_hist_util.cc:216 DenseCutsAccuracyTestWeights
+    F = 5
+    x = _gen(num_rows, F)
+    rng = np.random.RandomState(1)
+    w = rng.uniform(0.0, 1.0, num_rows).astype(np.float32)
+    d = xgb.DMatrix(x, weight=w)
+    bm = d.get_binned(num_bins, sketch_weights=w)
+    for f in range(F):
+        _validate_column(np.asarray(bm.cuts.values[f]),
+                         float(bm.cuts.min_vals[f]), x[:, f], w, num_bins)
+
+
+def test_hessian_sketch_equals_weight_product():  # test_hist_util.cc:232
+    """Hessian-weighted re-sketch (tree_method=approx) must equal sketching
+    with weight*hessian as the weights — the reference asserts value
+    equality within kRtEps."""
+    F = 5
+    num_rows = 1000
+    x = _gen(num_rows, F, seed=2)
+    rng = np.random.RandomState(1)
+    w = rng.uniform(0.0, 1.0, num_rows).astype(np.float32)
+    hess = rng.uniform(0.0, 1.0, num_rows).astype(np.float32)
+    rng2 = np.random.RandomState(0)
+    rng2.shuffle(hess)
+
+    d1 = xgb.DMatrix(x, weight=w)
+    cuts_hess = d1.build_binned(256, sketch_weights=w * hess).cuts
+    d2 = xgb.DMatrix(x, weight=w * hess)
+    cuts_wh = d2.build_binned(256, sketch_weights=w * hess).cuts
+    np.testing.assert_allclose(np.asarray(cuts_hess.values),
+                               np.asarray(cuts_wh.values), rtol=1e-6)
