@@ -39,16 +39,7 @@ def _row_sharded_call(mesh, grower, out_specs, args, feature_weights):
         mesh=mesh,
         in_specs=tuple(in_specs),
         out_specs=out_specs,
-        # check_vma: JAX's varying-manual-axes proof cannot establish that
-        # the tree arrays are replicated — the per-shard subsample key
-        # (fold_in(axis_index)) taints the gradient inputs, and invariance
-        # is only restored by the histogram psum, which the conservative
-        # analysis does not credit through the level loop's carry. The
-        # replicated-tree property is asserted at runtime instead by the
-        # mesh parity tests (tests/test_distributed.py), the same way the
-        # reference asserts it with gpu_hist's debug_synchronize
-        # (updater_gpu_hist.cu:49).
-        check_vma=False,
+        check_vma=True,
     )
     return fn(*args)
 
@@ -115,7 +106,7 @@ def distributed_grow_tree_fused(
         args = args + (feature_weights,)
     fn = jax.shard_map(
         grower, mesh=mesh, in_specs=tuple(in_specs), out_specs=out_specs,
-        check_vma=False,  # see _row_sharded_call
+        check_vma=True,
     )
     return fn(*args)
 
@@ -282,6 +273,6 @@ def _dist_scan_impl(bins, label, weight, margin, iters, cut_values, eta,
     fn = jax.shard_map(
         shard_fn, mesh=mesh, in_specs=tuple(in_specs),
         out_specs=(P(ROW_AXIS, None), tree_specs),
-        check_vma=False,  # see _row_sharded_call
+        check_vma=True,
     )
     return fn(*args)

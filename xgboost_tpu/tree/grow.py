@@ -634,8 +634,15 @@ def grow_tree(
     n_b = max_nodes if cfg.has_monotone else 1
     n_u = max_nodes if cfg.has_interaction else 1
     n_cs, b_cs = (max_nodes, B) if cfg.has_categorical else (1, 1)
+    pos0 = jnp.zeros((n,), jnp.int32)
+    if cfg.axis_name is not None:
+        # per-row positions are per-shard data: mark them varying up front
+        # so the loop carry types match under shard_map's check_vma
+        # (everything else in the carry stays provably replicated — the
+        # histogram psum restores invariance each level)
+        pos0 = jax.lax.pcast(pos0, (cfg.axis_name,), to="varying")
     init = (
-        jnp.zeros((n,), jnp.int32),
+        pos0,
         jnp.zeros((max_nodes,), bool),
         jnp.zeros((max_nodes,), jnp.int32),
         jnp.zeros((max_nodes,), jnp.int32),

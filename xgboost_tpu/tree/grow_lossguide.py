@@ -235,6 +235,10 @@ def grow_tree_lossguide(
 
     # ---- root ----
     pos = jnp.zeros((n,), jnp.int32)
+    if cfg.axis_name is not None:
+        # per-row positions are per-shard data: mark varying so the
+        # expansion loop's carry types line up under check_vma
+        pos = jax.lax.pcast(pos, (cfg.axis_name,), to="varying")
     h0 = pair_hist(jnp.zeros((n,), jnp.int32))[:1]  # all rows as "left"
     G0 = h0[0, 0, :, 0].sum()
     H0 = h0[0, 0, :, 1].sum()
