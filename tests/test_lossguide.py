@@ -169,3 +169,40 @@ def test_lossguide_update_many_scan_matches_per_round():
     b3 = xgb.Booster(model_file=blob)
     np.testing.assert_allclose(b3.predict(d2), b2.predict(d2),
                                rtol=1e-5, atol=1e-6)
+
+
+def test_lossguide_chunk_backed_model_paths():
+    """update_many stores lossguide scan chunks whole (_PendingAllocChunk);
+    eval-cache catch-up must use the DEVICE stacker over chunk refs, and
+    save/load must round-trip."""
+    import numpy as np
+
+    from xgboost_tpu.gbm.gbtree import _AllocChunkRef
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(900, 5).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] > 0).astype(np.float32)
+    d1 = xgb.DMatrix(X[:700], label=y[:700])
+    d2 = xgb.DMatrix(X[700:], label=y[700:])
+    bst = xgb.Booster({"objective": "binary:logistic",
+                       "grow_policy": "lossguide",
+                       "max_leaves": 16, "max_depth": 0}, [d1, d2])
+    bst.update_many(d1, 0, 6, chunk=3)
+    model = bst._gbm.model
+    assert any(isinstance(e, _AllocChunkRef) for e in model._entries)
+    # the device stacker handles chunk refs WITHOUT host materialization
+    sf = model.stacked_slice(0, model.num_trees)
+    assert sf.left.shape[0] >= model.num_trees
+    assert any(isinstance(e, _AllocChunkRef) for e in model._entries)
+    line = bst.eval(d2, "val", 5)
+    assert "val-logloss" in line
+    p = bst.predict(xgb.DMatrix(X))
+    import tempfile
+    import os
+
+    with tempfile.TemporaryDirectory() as td:
+        fp = os.path.join(td, "m.json")
+        bst.save_model(fp)
+        b2 = xgb.Booster(model_file=fp)
+        np.testing.assert_allclose(b2.predict(xgb.DMatrix(X)), p,
+                                   rtol=1e-5, atol=1e-6)

@@ -118,6 +118,68 @@ class _ChunkRef:
         return self.chunk.n_nodes
 
 
+class _PendingAllocChunk:
+    """Lossguide twin of _PendingChunk: a scan chunk of allocation-ordered
+    trees held as the scan's [R, K, M] device outputs (alloc fields +
+    on-device keep/leaf_value); per-tree carving happens on host, one bulk
+    transfer per field per chunk."""
+
+    __slots__ = ("fields", "R", "K", "eta", "gamma", "max_depth",
+                 "cat_mask", "_host")
+
+    ALLOC_FIELDS = ("left", "right", "feature", "split_bin", "split_cond",
+                    "default_left", "node_weight", "loss_chg", "node_h",
+                    "cat_set", "n_nodes", "depth")
+
+    def __init__(self, alloc_stacked, keep, leaf_value, R, K, eta, gamma,
+                 max_depth, cat_mask):
+        self.fields = {f: getattr(alloc_stacked, f)
+                       for f in self.ALLOC_FIELDS}
+        self.fields["keep"] = keep
+        self.fields["leaf_value"] = leaf_value
+        self.R, self.K = R, K
+        self.eta, self.gamma = eta, gamma
+        self.max_depth = max_depth
+        self.cat_mask = cat_mask
+        self._host = None
+
+    def host(self):
+        """Bulk transfer of exactly what RegTree.from_alloc consumes (keep/
+        leaf_value/depth serve only the DEVICE stacker; cat_set only when
+        categorical)."""
+        if self._host is None:
+            skip = {"keep", "leaf_value", "depth"}
+            if self.cat_mask is None:
+                skip.add("cat_set")
+            self._host = {f: np.asarray(a)
+                          for f, a in self.fields.items() if f not in skip}
+        return self._host
+
+    def flat(self, f: str) -> jax.Array:
+        """[R*K, M] device view in tree order — a free reshape."""
+        a = self.fields[f]
+        return a.reshape(a.shape[0] * a.shape[1], *a.shape[2:])
+
+
+class _AllocChunkRef:
+    """Per-tree placeholder into a _PendingAllocChunk."""
+
+    __slots__ = ("chunk", "r", "k")
+
+    def __init__(self, chunk: _PendingAllocChunk, r: int, k: int):
+        self.chunk = chunk
+        self.r = r
+        self.k = k
+
+    @property
+    def flat_index(self) -> int:
+        return self.r * self.chunk.K + self.k
+
+    @property
+    def cat_mask(self):
+        return self.chunk.cat_mask
+
+
 def _pad_stack(arrs, n_cols: int, col_pad: int, row_pad: int, fill, dtype):
     """Stack 1-D per-tree arrays into a [row_pad, col_pad] device matrix:
     per-array pad to ``n_cols`` then to pow2 ``col_pad`` columns and
@@ -374,6 +436,19 @@ class GBTreeModel:
                 self.tree_info.append(k)
         self._stacked = None
 
+    def add_device_alloc_chunk(self, alloc_stacked, keep, leaf_value,
+                               R: int, K: int, eta: float, gamma: float,
+                               max_depth: int, cat_mask) -> None:
+        """Lossguide twin of add_device_chunk: a whole scan chunk appended
+        without slicing per-tree device arrays."""
+        chunk = _PendingAllocChunk(alloc_stacked, keep, leaf_value, R, K,
+                                   eta, gamma, max_depth, cat_mask)
+        for r in range(R):
+            for k in range(K):
+                self._entries.append(_AllocChunkRef(chunk, r, k))
+                self.tree_info.append(k)
+        self._stacked = None
+
     def add_device_alloc(self, alloc, keep, leaf_value, eta: float,
                          gamma: float, group: int, max_depth: int,
                          cat_mask) -> None:
@@ -389,9 +464,11 @@ class GBTreeModel:
                    if isinstance(e, _PendingTree)]
         alloc_ix = [i for i, e in enumerate(self._entries)
                     if isinstance(e, _PendingAllocTree)]
-        ref_any = any(isinstance(e, _ChunkRef) for e in self._entries)
+        ref_any = any(isinstance(e, (_ChunkRef, _AllocChunkRef))
+                      for e in self._entries)
         if ref_any:
             _materialize_chunk_refs(self._entries)
+            _materialize_alloc_chunk_refs(self._entries)
         if heap_ix:
             converted = _materialize_pending(
                 [self._entries[i] for i in heap_ix]
@@ -440,6 +517,13 @@ class GBTreeModel:
         if ents and all(isinstance(e, _PendingAllocTree) for e in ents):
             return _stack_device_alloc(ents, self.tree_info[lo:hi],
                                        self.n_groups)
+        if ents and all(
+            isinstance(e, (_PendingAllocTree, _AllocChunkRef))
+            and getattr(e, "cat_mask", None) is None
+            for e in ents
+        ):
+            return _stack_device_alloc_mixed(ents, self.tree_info[lo:hi],
+                                             self.n_groups)
         trees = self.trees[lo:hi]
         return stack_forest(trees, self.tree_info[lo:hi], self.n_groups)
 
@@ -586,6 +670,94 @@ def _scan_rounds_lossguide_impl(bins, label, weight, m_cur, iters, cut_vals,
         return m_cur, stacked
 
     return jax.lax.scan(body, m_cur, iters)
+
+
+def _stack_device_alloc_mixed(entries: List[Any], tree_info,
+                              n_groups: int) -> StackedForest:
+    """Device-stacked forest over a mixture of _PendingAllocTree and
+    _AllocChunkRef entries (numerical-only — categorical lossguide never
+    reaches the scan path): consecutive refs into one chunk contribute one
+    reshape+slice, like _stack_device_mixed for the depthwise twin."""
+    T = len(entries)
+    Tp = 1 << (T - 1).bit_length() if T > 1 else 1
+
+    def width(e):
+        if isinstance(e, _AllocChunkRef):
+            return int(e.chunk.fields["left"].shape[2])
+        return int(e.left.shape[0])
+
+    M = max(width(e) for e in entries)
+    Mp = max(1, 1 << (M - 1).bit_length())
+
+    def field2d(name, fill, dtype):
+        segs = []
+        i = 0
+        while i < T:
+            e = entries[i]
+            if isinstance(e, _AllocChunkRef):
+                c, start = e.chunk, e.flat_index
+                j = i + 1
+                while (j < T and isinstance(entries[j], _AllocChunkRef)
+                       and entries[j].chunk is c
+                       and entries[j].flat_index == start + (j - i)):
+                    j += 1
+                seg = c.flat(name)[start:start + (j - i)]
+                i = j
+            else:
+                seg = getattr(e, name)[None]
+                i += 1
+            if seg.shape[1] != Mp:
+                seg = jnp.pad(seg, ((0, 0), (0, Mp - seg.shape[1])),
+                              constant_values=fill)
+            segs.append(seg)
+        s = segs[0] if len(segs) == 1 else jnp.concatenate(segs)
+        if s.shape[0] != Tp:
+            s = jnp.pad(s, ((0, Tp - s.shape[0]), (0, 0)),
+                        constant_values=fill)
+        return s.astype(dtype)
+
+    keep = field2d("keep", False, bool)
+    left = jnp.where(keep, field2d("left", -1, jnp.int32), -1)
+    right = jnp.where(keep, field2d("right", -1, jnp.int32), -1)
+    cond = jnp.where(keep, field2d("split_cond", 0.0, jnp.float32),
+                     field2d("leaf_value", 0.0, jnp.float32))
+    md = 1 + int(max(
+        int(jnp.max(e.chunk.fields["depth"])) if isinstance(e, _AllocChunkRef)
+        else int(jnp.max(e.depth))
+        for e in entries))
+    group = np.zeros(Tp, np.int32)
+    group[:T] = np.asarray(tree_info, np.int32)
+    return StackedForest(
+        left=left, right=right,
+        feature=field2d("feature", 0, jnp.int32), cond=cond,
+        default_left=field2d("default_left", False, bool),
+        split_type=jnp.zeros((Tp, Mp), bool),
+        cat_bits=jnp.zeros((Tp, Mp, 1), jnp.uint32),
+        tree_group=jnp.asarray(group), max_depth=max(md, 1),
+        n_groups=n_groups, has_cats=False, heap_layout=False,
+    )
+
+
+def _materialize_alloc_chunk_refs(entries: List[Any]) -> None:
+    """Replace every _AllocChunkRef (in place) with a host RegTree; one
+    bulk transfer per field per chunk, numpy slicing per tree. from_alloc
+    re-runs the gamma prune host-side exactly like the per-tree
+    materializer (_materialize_pending_alloc)."""
+    for i, e in enumerate(entries):
+        if not isinstance(e, _AllocChunkRef):
+            continue
+        h = e.chunk.host()
+        c = e.chunk
+        r, k = e.r, e.k
+        tree, _ = RegTree.from_alloc(
+            h["left"][r, k], h["right"][r, k], h["feature"][r, k],
+            h["split_cond"][r, k], h["default_left"][r, k],
+            h["node_weight"][r, k], h["loss_chg"][r, k], h["node_h"][r, k],
+            int(h["n_nodes"][r, k]), eta=c.eta, min_split_loss=c.gamma,
+            split_bin=h["split_bin"][r, k], cat_features=c.cat_mask,
+            cat_set=(h["cat_set"][r, k] if c.cat_mask is not None else None),
+        )
+        entries[i] = tree
 
 
 def _materialize_chunk_refs(entries: List[Any]) -> None:
@@ -1272,17 +1444,10 @@ class GBTree:
             jnp.uint32(seed_base), obj=obj, obj_fp=_obj_fingerprint(obj),
             cfg=cfg, n_groups=K, max_leaves=max_leaves,
         )
-        cat_mask = None
-        for r in range(num_rounds):
-            for k in range(K):
-                alloc = jax.tree_util.tree_map(
-                    lambda a, r=r, k=k: a[r, k], stacked[0])
-                keep = stacked[1][r, k]
-                lv = stacked[2][r, k]
-                self.model.add_device_alloc(
-                    alloc, keep, lv, tp.eta, tp.gamma, k, tp.max_depth,
-                    cat_mask,
-                )
+        self.model.add_device_alloc_chunk(
+            stacked[0], stacked[1], stacked[2], num_rounds, K,
+            tp.eta, tp.gamma, tp.max_depth, cat_mask=None,
+        )
         return m_cur
 
     # ------------------------------------------------------------------
