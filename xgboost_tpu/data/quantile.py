@@ -298,6 +298,13 @@ class BinnedMatrix:
         if not can_hoist(n_pad, self.n_features, B, max_depth):
             return None
         if self._onehot is None:
+            from ..utils import console_logger
+
+            gb = n_pad * self.n_features * B / 1e9
+            console_logger.info(
+                f"tpu_hist: hoisted one-hot active — {gb:.2f} GB "
+                f"HBM-resident ({n_pad}x{self.n_features}x{B} int8); "
+                "levels stream it through the MXU")
             self._onehot = build_onehot(bins, B=B)
         return self._onehot
 
