@@ -29,7 +29,45 @@ from .dmatrix import DMatrix, MetaInfo
 from .iterator import DataIter
 from .quantile import HistogramCuts, bin_matrix, storage_dtype
 
-__all__ = ["ExternalMemoryQuantileDMatrix", "PagedBins"]
+__all__ = ["ExternalMemoryQuantileDMatrix", "PagedBins", "pack_symbols",
+           "unpack_symbols"]
+
+
+def _symbol_bits(n_symbols: int) -> int:
+    """Bits per stored symbol: ceil(log2(n_symbols)) — the reference's
+    ELLPACK symbol width (common/compressed_iterator.h SymbolBits)."""
+    return max(1, int(np.ceil(np.log2(max(n_symbols, 2)))))
+
+
+def pack_symbols(arr: np.ndarray, bits: int) -> np.ndarray:
+    """Pack an integer array (values < 2^bits) into a dense little-endian
+    bitstream — log2(bins) bits per entry instead of a whole byte, the
+    reference's CompressedBufferWriter (common/compressed_iterator.h:85).
+    Vectorized via unpackbits/packbits (C speed)."""
+    flat = np.ascontiguousarray(arr).reshape(-1)
+    # little-endian byte view: [n, itemsize] uint8
+    nbytes = flat.dtype.itemsize
+    as_bytes = flat.astype(f"<u{nbytes}").view(np.uint8).reshape(-1, nbytes)
+    bit_rows = np.unpackbits(as_bytes, axis=1, bitorder="little")[:, :bits]
+    return np.packbits(bit_rows.reshape(-1), bitorder="little")
+
+
+def unpack_symbols(packed: np.ndarray, bits: int, count: int,
+                   dtype) -> np.ndarray:
+    """Inverse of pack_symbols: recover ``count`` symbols. Symmetric
+    uint8 pipeline (unpackbits -> zero-pad to the itemsize -> packbits ->
+    byte view): 1 byte per stored bit of transients and no matmul — this
+    sits on the paged grower's per-level read path."""
+    dt = np.dtype(dtype)
+    bit_stream = np.unpackbits(packed, bitorder="little",
+                               count=count * bits)
+    bit_rows = bit_stream.reshape(count, bits)
+    width = dt.itemsize * 8
+    if bits != width:
+        bit_rows = np.concatenate(
+            [bit_rows, np.zeros((count, width - bits), np.uint8)], axis=1)
+    as_bytes = np.packbits(bit_rows.reshape(-1), bitorder="little")
+    return as_bytes.view(f"<u{dt.itemsize}").astype(dtype, copy=False)
 
 
 class PagedBins:
@@ -48,6 +86,19 @@ class PagedBins:
         self.n_pages = -(-n_rows // page_rows)
         self._handle = None
         self._lib = None
+        # ELLPACK symbol compression: log2(bins+1) bits per entry on disk
+        # (bin ids 0..max_bin inclusive of the missing sentinel). Packing
+        # is skipped when it wouldn't shrink the page.
+        n_symbols = cuts.values.shape[1] + 1
+        self.bits = _symbol_bits(n_symbols)
+        self.packed = self.bits < 8 * self.dtype.itemsize
+
+    def page_bytes(self, k: int) -> int:
+        """On-disk byte size of page k (packed or raw)."""
+        n_sym = self.rows_of(k) * self.n_features
+        if self.packed:
+            return (n_sym * self.bits + 7) // 8
+        return n_sym * self.dtype.itemsize
 
     # the gbtree fast path keys off this marker
     is_paged = True
@@ -100,8 +151,7 @@ class PagedBins:
             import ctypes
 
             sizes = (ctypes.c_longlong * self.n_pages)(
-                *[self.rows_of(k) * self.n_features * self.dtype.itemsize
-                  for k in range(self.n_pages)]
+                *[self.page_bytes(k) for k in range(self.n_pages)]
             )
             self._handle = self._lib.pc_open(
                 self.prefix.encode(), self.n_pages, sizes, 4
@@ -109,20 +159,24 @@ class PagedBins:
 
     def read_page(self, k: int) -> np.ndarray:
         """[rows_of(k), F] narrow-int bins; prefetch of k+1 starts in the
-        native worker before this call returns."""
+        native worker before this call returns. Pages are stored
+        bit-packed (``self.bits`` per entry) and unpacked here."""
         rows = self.rows_of(k)
-        out = np.empty((rows, self.n_features), self.dtype)
+        raw = np.empty((self.page_bytes(k),), np.uint8)
         self._open()
+        got = False
         if self._handle:
             rc = self._lib.pc_read(
                 self._handle, k,
-                out.ctypes.data_as(__import__("ctypes").c_void_p),
+                raw.ctypes.data_as(__import__("ctypes").c_void_p),
             )
-            if rc == 0:
-                return out
-        return np.fromfile(self.page_path(k), dtype=self.dtype).reshape(
-            rows, self.n_features
-        )
+            got = rc == 0
+        if not got:
+            raw = np.fromfile(self.page_path(k), dtype=np.uint8)
+        if self.packed:
+            return unpack_symbols(raw, self.bits, rows * self.n_features,
+                                  self.dtype).reshape(rows, self.n_features)
+        return raw.view(self.dtype).reshape(rows, self.n_features)
 
     def close(self) -> None:
         if self._handle and self._lib is not None:
@@ -208,6 +262,8 @@ class ExternalMemoryQuantileDMatrix(DMatrix):
 
         def write_page(k: int, arr: np.ndarray) -> None:
             arr = np.ascontiguousarray(arr)
+            if paged.packed:  # ELLPACK symbol compression on disk
+                arr = pack_symbols(arr, paged.bits)
             if lib is not None:
                 import ctypes
 
