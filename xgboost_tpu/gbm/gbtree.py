@@ -672,6 +672,40 @@ def _scan_rounds_lossguide_impl(bins, label, weight, m_cur, iters, cut_vals,
     return jax.lax.scan(body, m_cur, iters)
 
 
+def _chunked_field2d(entries: List[Any], ref_type, name: str, Np: int,
+                     Tp: int, fill, dtype) -> jax.Array:
+    """[Tp, Np] device matrix of one per-tree field over a mixed pending
+    list: consecutive ``ref_type`` refs into the same chunk contribute ONE
+    reshape+slice of the chunk's [R*K, ...] view; plain pending trees
+    contribute their own array. Shared by both mixed stackers so the
+    run-detection/padding policy has a single home."""
+    T = len(entries)
+    segs = []
+    i = 0
+    while i < T:
+        e = entries[i]
+        if isinstance(e, ref_type):
+            c, start = e.chunk, e.flat_index
+            j = i + 1
+            while (j < T and isinstance(entries[j], ref_type)
+                   and entries[j].chunk is c
+                   and entries[j].flat_index == start + (j - i)):
+                j += 1
+            seg = c.flat(name)[start:start + (j - i)]
+            i = j
+        else:
+            seg = getattr(e, name)[None]
+            i += 1
+        if seg.shape[1] != Np:
+            seg = jnp.pad(seg, ((0, 0), (0, Np - seg.shape[1])),
+                          constant_values=fill)
+        segs.append(seg)
+    s = segs[0] if len(segs) == 1 else jnp.concatenate(segs)
+    if s.shape[0] != Tp:
+        s = jnp.pad(s, ((0, Tp - s.shape[0]), (0, 0)), constant_values=fill)
+    return s.astype(dtype)
+
+
 def _stack_device_alloc_mixed(entries: List[Any], tree_info,
                               n_groups: int) -> StackedForest:
     """Device-stacked forest over a mixture of _PendingAllocTree and
@@ -690,31 +724,8 @@ def _stack_device_alloc_mixed(entries: List[Any], tree_info,
     Mp = max(1, 1 << (M - 1).bit_length())
 
     def field2d(name, fill, dtype):
-        segs = []
-        i = 0
-        while i < T:
-            e = entries[i]
-            if isinstance(e, _AllocChunkRef):
-                c, start = e.chunk, e.flat_index
-                j = i + 1
-                while (j < T and isinstance(entries[j], _AllocChunkRef)
-                       and entries[j].chunk is c
-                       and entries[j].flat_index == start + (j - i)):
-                    j += 1
-                seg = c.flat(name)[start:start + (j - i)]
-                i = j
-            else:
-                seg = getattr(e, name)[None]
-                i += 1
-            if seg.shape[1] != Mp:
-                seg = jnp.pad(seg, ((0, 0), (0, Mp - seg.shape[1])),
-                              constant_values=fill)
-            segs.append(seg)
-        s = segs[0] if len(segs) == 1 else jnp.concatenate(segs)
-        if s.shape[0] != Tp:
-            s = jnp.pad(s, ((0, Tp - s.shape[0]), (0, 0)),
-                        constant_values=fill)
-        return s.astype(dtype)
+        return _chunked_field2d(entries, _AllocChunkRef, name, Mp, Tp,
+                                fill, dtype)
 
     keep = field2d("keep", False, bool)
     left = jnp.where(keep, field2d("left", -1, jnp.int32), -1)
@@ -795,31 +806,8 @@ def _stack_device_mixed(entries: List[Any], tree_info, n_groups: int
     md = max(e.max_depth for e in entries)
 
     def field2d(name, fill, dtype):
-        segs = []
-        i = 0
-        while i < T:
-            e = entries[i]
-            if isinstance(e, _ChunkRef):
-                c, start = e.chunk, e.flat_index
-                j = i + 1
-                while (j < T and isinstance(entries[j], _ChunkRef)
-                       and entries[j].chunk is c
-                       and entries[j].flat_index == start + (j - i)):
-                    j += 1
-                seg = c.flat(name)[start:j - i + start]
-                i = j
-            else:
-                seg = getattr(e, name)[None]
-                i += 1
-            if seg.shape[1] != Np:
-                seg = jnp.pad(seg, ((0, 0), (0, Np - seg.shape[1])),
-                              constant_values=fill)
-            segs.append(seg)
-        s = segs[0] if len(segs) == 1 else jnp.concatenate(segs)
-        if s.shape[0] != Tp:
-            s = jnp.pad(s, ((0, Tp - s.shape[0]), (0, 0)),
-                        constant_values=fill)
-        return s.astype(dtype)
+        return _chunked_field2d(entries, _ChunkRef, name, Np, Tp, fill,
+                                dtype)
 
     keep = field2d("keep", False, bool)
     iota = jnp.arange(Np, dtype=jnp.int32)[None, :]
