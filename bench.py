@@ -201,6 +201,15 @@ def _run_configs(args, suffix: str, final: dict) -> None:
     point still reports the best completed measurement."""
     import jax
 
+    if suffix == "_cpu_fallback":
+        # a wedged TPU relay degraded us to CPU: scale the workload so a
+        # marked number still lands within the driver's patience (the
+        # metric name carries both the row count and the fallback marker)
+        args.rows = min(args.rows, 100_000)
+        args.chunk = min(args.chunk, 5)
+        print(f"# cpu fallback: rows capped to {args.rows}, chunk "
+              f"{args.chunk}", file=sys.stderr, flush=True)
+
     try:
         if jax.default_backend() == "tpu":
             # persistent compilation cache: later runs (and the driver's)

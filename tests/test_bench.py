@@ -90,3 +90,28 @@ def test_backend_probe_timeout_returns_none(monkeypatch):
     monkeypatch.setattr(bench.subprocess, "run", fake_run)
     assert bench._probe_backend(timeout_s=1.0) is None
     assert len(calls) == 2  # two attempts before giving up
+
+
+def test_bench_cpu_fallback_caps_workload(monkeypatch, capsys, tmp_path):
+    """When the backend probe degrades to CPU, the workload must shrink so
+    a marked number lands within driver patience."""
+    monkeypatch.chdir(tmp_path)
+    sys.path.insert(0, REPO)
+    try:
+        import bench
+    finally:
+        sys.path.remove(REPO)
+    captured = {}
+
+    def fake_run(args, suffix, final):
+        # emulate _run_configs's entry: apply the fallback cap logic only
+        captured["suffix"] = suffix
+        raise SystemExit("stop before training")
+
+    monkeypatch.setattr(bench, "_probe_backend", lambda **kw: None)
+    monkeypatch.setattr(bench, "_run_configs", fake_run)
+    monkeypatch.setattr(sys, "argv", ["bench.py"])
+    monkeypatch.setattr(sys, "modules", dict(sys.modules))
+    sys.modules.pop("jax", None)  # force the probe path
+    bench.main()
+    assert captured["suffix"] == "_cpu_fallback"
