@@ -536,3 +536,22 @@ def test_golden_poisson_mds_survives_pickle():
                       "max_delta_step": 0.1}, d, 2, verbose_eval=False)
     b4 = pickle.loads(pickle.dumps(bst3))
     assert b4._obj._max_delta_step() == pytest.approx(0.1)
+
+
+def test_golden_aft_nloglik_metric():  # test_survival_metric.cu:50
+    """Aggregate aft-nloglik over the reference's 4-row mixed-censoring
+    fixture, per distribution."""
+    from xgboost_tpu.metric import create_metric
+
+    preds = jnp.full((4,), math.log(64.0), jnp.float32)
+    lab = jnp.zeros((4,), jnp.float32)
+    lower = jnp.asarray([100.0, 0.0, 60.0, 16.0], jnp.float32)
+    upper = jnp.asarray([100.0, 20.0, float("inf"), 200.0], jnp.float32)
+    for dist, want in (("normal", 2.1508), ("logistic", 2.1804),
+                       ("extreme", 2.0706)):
+        m = create_metric("aft-nloglik")
+        m.lparam = _P(aft_loss_distribution=dist,
+                      aft_loss_distribution_scale=1.0)
+        got = float(m.evaluate(preds, lab, label_lower=lower,
+                               label_upper=upper))
+        assert got == pytest.approx(want, abs=2e-3), (dist, got, want)

@@ -97,7 +97,13 @@ class AFT(ObjFunction):
         log_yu = jnp.log(jnp.maximum(
             jnp.where(jnp.isfinite(y_upper), y_upper, 1.0), _EPS))
         z_u = (log_yu - margin) / sigma
-        ll_unc = jnp.log(jnp.maximum(pdf(z_l), _EPS) / sigma)
+        # uncensored density includes the 1/(sigma*y) change-of-variables
+        # Jacobian (survival_util.h AFTLoss::Loss kUncensored) — constant
+        # in the margin, so gradients are unaffected but the METRIC value
+        # must carry it (test_survival_metric.cu:50 pins the aggregate)
+        ll_unc = jnp.log(
+            jnp.maximum(pdf(z_l), _EPS)
+            / (sigma * jnp.maximum(y_lower, _EPS)))
         ll_right = jnp.log(jnp.maximum(1.0 - cdf(z_l), _EPS))
         ll_int = jnp.log(jnp.maximum(cdf(z_u) - cdf(z_l), _EPS))
         return jnp.where(uncensored, ll_unc,
