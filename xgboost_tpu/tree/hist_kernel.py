@@ -47,6 +47,10 @@ __all__ = [
 TR = 1024  # rows per kernel grid step
 TR_HOIST = 512  # rows per grid step for the hoisted-one-hot kernel
 
+# test hook: run pallas_calls in interpret mode (lets the CPU suite
+# execute the REAL kernel bodies, including under shard_map)
+_INTERPRET = False
+
 # 0xFFFF0000 as int32: masks an f32 down to its bf16-representable prefix
 _MASK_HI = np.int32(np.uint32(0xFFFF0000).view(np.int32))
 
@@ -255,6 +259,7 @@ def _fused_level_pallas(bins, pos, gh, ptab, *, K, Kp, B, d, tr=TR):
             jax.ShapeDtypeStruct((n, 1), jnp.int32),
             jax.ShapeDtypeStruct((F, 2 * K, B), jnp.float32),
         ],
+        interpret=_INTERPRET,
     )(bins, pos, gh, ptab)
 
 
@@ -325,6 +330,7 @@ def _hoisted_level_pallas(bins, onehot, pos, gh, ptab, *, K, Kp, B, d,
             jax.ShapeDtypeStruct((n, 1), jnp.int32),
             jax.ShapeDtypeStruct((2 * K, Q), jnp.float32),
         ],
+        interpret=_INTERPRET,
     )(bins, onehot, pos, gh, ptab)
     # [2K, F*B] -> the dispatcher contract [F, 2K, B]
     hist = jnp.transpose(hist2.reshape(2 * K, F, B), (1, 0, 2))
