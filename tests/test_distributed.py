@@ -207,49 +207,67 @@ def test_mesh_update_many_scan_matches_per_round():
 
 
 def test_mosaic_kernels_under_shard_map_interpret():
-    """The REAL pallas level-kernel body executes under shard_map via
-    interpret mode and grows trees matching the XLA fallback — this pins
-    the mesh+pallas composition that round 3 had gated off (VERDICT weak
-    #6); hardware validates Mosaic itself. (The hoisted kernel's body is
-    pinned by tests/test_hoisted.py; the mesh path streams it only once a
-    sharded one-hot is wired, so here the construct kernel runs.)"""
-    import numpy as np
+    """The REAL pallas level-kernel bodies (construct AND hoisted) execute
+    under shard_map via interpret mode and grow trees matching the XLA
+    fallback — pinning the mesh+pallas composition round 3 had gated off
+    (VERDICT weak #6). The interpreted replay cannot run under the VMA
+    checker (it re-evaluates the kernel jaxpr op-by-op, which real Mosaic
+    lowering never does), so this test drives its own check_vma=False
+    shard_map; the boundary proof itself is exercised with check_vma=True
+    by every other mesh test through the library path."""
+    import dataclasses
 
-    from xgboost_tpu.parallel.grow import distributed_grow_tree_fused
-    from xgboost_tpu.parallel.mesh import make_mesh, shard_rows, replicate
-    from xgboost_tpu.tree import grow_fused as gf
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from xgboost_tpu.parallel.mesh import ROW_AXIS, make_mesh, shard_rows
     from xgboost_tpu.tree import hist_kernel as hk
     from xgboost_tpu.tree.grow import GrowParams
+    from xgboost_tpu.tree.grow_fused import GrownTree, grow_tree_fused
+    from xgboost_tpu.tree.hist_kernel import build_onehot
 
     rng = np.random.RandomState(0)
-    n_pad, F, B = 4096, 4, 16  # multiple of TR so both tiles divide
+    n_pad, F, B = 4096, 4, 16  # multiple of both row tiles
     bins = rng.randint(0, B, size=(n_pad, F)).astype(np.int32)
     g = rng.randn(n_pad).astype(np.float32)
     h = np.abs(rng.randn(n_pad)).astype(np.float32) + 0.1
     cut_vals = np.sort(rng.randn(F, B).astype(np.float32), axis=1)
-    cfg = GrowParams(max_depth=3)
+    cfg = dataclasses.replace(GrowParams(max_depth=3), axis_name=ROW_AXIS)
     mesh = make_mesh(4)
+    out_specs = GrownTree(**{f: (P(ROW_AXIS) if f == "delta" else P())
+                             for f in GrownTree._fields})
 
-    def run():
-        key = jax.random.PRNGKey(0)
-        t = distributed_grow_tree_fused(
-            mesh, shard_rows(jnp.asarray(bins), mesh),
-            shard_rows(jnp.asarray(g), mesh),
-            shard_rows(jnp.asarray(h), mesh),
-            jnp.asarray(cut_vals), key,
-            jnp.float32(0.3), jnp.float32(0.0), cfg)
+    def run(hoist: bool):
+        def grower(bins_s, g_s, h_s, cuts_s, key_s):
+            onehot = build_onehot(bins_s, B=B) if hoist else None
+            return grow_tree_fused(bins_s, g_s, h_s, cuts_s, key_s,
+                                   jnp.float32(0.3), jnp.float32(0.0),
+                                   cfg=cfg, onehot=onehot)
+
+        fn = jax.shard_map(
+            grower, mesh=mesh,
+            in_specs=(P(ROW_AXIS, None), P(ROW_AXIS), P(ROW_AXIS),
+                      P(None, None), P()),
+            out_specs=out_specs, check_vma=False)
+        t = fn(shard_rows(jnp.asarray(bins), mesh),
+               shard_rows(jnp.asarray(g), mesh),
+               shard_rows(jnp.asarray(h), mesh),
+               jnp.asarray(cut_vals), jax.random.PRNGKey(0))
         return {f: np.asarray(getattr(t, f))
                 for f in ("keep", "feature", "split_bin", "leaf_value")}
 
-    ref = run()  # XLA fallback (use_pallas False on CPU)
+    ref = run(False)  # XLA fallback (use_pallas False on CPU)
     orig_up, orig_int = hk.use_pallas, hk._INTERPRET
     try:
         hk._INTERPRET = True
         hk.use_pallas = lambda: True  # force the pallas dispatch path
-        got = run()
+        got_construct = run(False)
+        got_hoisted = run(True)
     finally:
         hk._INTERPRET = orig_int
         hk.use_pallas = orig_up
-    for f in ref:
-        np.testing.assert_allclose(got[f], ref[f], rtol=2e-4, atol=2e-4,
-                                   err_msg=f)
+    for name, got in (("construct", got_construct),
+                      ("hoisted", got_hoisted)):
+        for f in ref:
+            np.testing.assert_allclose(got[f], ref[f], rtol=2e-4,
+                                       atol=2e-4, err_msg=f"{name}:{f}")

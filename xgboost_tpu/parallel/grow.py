@@ -92,11 +92,28 @@ def distributed_grow_tree_fused(
     back replicated, the per-row cache delta stays sharded."""
     import dataclasses
 
+    from ..tree.hist_kernel import build_onehot, can_hoist
+
     cfg_dist = dataclasses.replace(cfg, axis_name=ROW_AXIS)
     out_specs = GrownTree(
         **{f: (P(ROW_AXIS) if f == "delta" else P()) for f in GrownTree._fields}
     )
-    grower = partial(grow_tree_fused, cfg=cfg_dist)
+    # per-SHARD hoisted one-hot: each shard builds its own rows' expansion
+    # inside the shard_map (training-invariant per call; the single-chip
+    # caching lives a level up in BinnedMatrix, here one build amortizes
+    # over the tree's levels) — the distributed path streams the same
+    # kernel the single-chip bench measures
+    B = cut_values.shape[1]
+    shard_rows_n = bins.shape[0] // mesh.devices.size
+    hoist = (not cfg.has_categorical
+             and can_hoist(shard_rows_n, bins.shape[1], B, cfg.max_depth))
+
+    def grower(bins_s, g_s, h_s, cuts_s, key_s, eta_s, gamma_s, *rest):
+        onehot = build_onehot(bins_s, B=B) if hoist else None
+        fw = rest[0] if rest else None
+        return grow_tree_fused(bins_s, g_s, h_s, cuts_s, key_s, eta_s,
+                               gamma_s, cfg=cfg_dist, feature_weights=fw,
+                               onehot=onehot)
 
     in_specs = [P(ROW_AXIS, None), P(ROW_AXIS), P(ROW_AXIS), P(None, None),
                 P(), P(), P()]
@@ -218,10 +235,18 @@ def _dist_scan_impl(bins, label, weight, margin, iters, cut_values, eta,
 
     from ..gbm.gbtree import round_seed_traced
 
+    from ..tree.hist_kernel import build_onehot, can_hoist
+
     cfg_dist = dataclasses.replace(cfg, axis_name=ROW_AXIS)
     D = mesh.devices.size
     n_pad, K = margin.shape
     rows_local = n_pad // D
+    B = cut_values.shape[1]
+    # per-shard hoisted one-hot, built ONCE per chunk outside the scan
+    # body (loop-invariant): the distributed scan streams the same kernel
+    # the single-chip bench measures
+    hoist = (not cfg.has_categorical
+             and can_hoist(rows_local, bins.shape[1], B, cfg.max_depth))
 
     def shard_fn(bins_s, label_s, weight_s, m_s, fw, n_a):
         r = jax.lax.axis_index(ROW_AXIS)
@@ -233,6 +258,7 @@ def _dist_scan_impl(bins, label, weight, margin, iters, cut_values, eta,
                  + jax.lax.broadcasted_iota(jnp.int32, (rows_local, 1), 0)[:, 0]
                  ) < n_own
         validf = valid.astype(jnp.float32)
+        onehot_s = build_onehot(bins_s, B=B) if hoist else None
 
         def body(m_loc, i):
             m = m_loc[:, 0] if K == 1 else m_loc
@@ -244,7 +270,8 @@ def _dist_scan_impl(bins, label, weight, margin, iters, cut_values, eta,
                 seed = round_seed_traced(seed_base, i, k)
                 key = jax.random.PRNGKey(seed.astype(jnp.int32))
                 t = grow_tree_fused(bins_s, gk, hk, cut_values, key, eta,
-                                    gamma, cfg_dist, feature_weights=fw)
+                                    gamma, cfg_dist, feature_weights=fw,
+                                    onehot=onehot_s)
                 m_loc = m_loc.at[:, k].add(t.delta)
                 trees.append(t._replace(delta=jnp.zeros((0,), jnp.float32)))
             return m_loc, jtu.tree_map(lambda *xs: jnp.stack(xs), *trees)

@@ -357,7 +357,7 @@ def grow_tree_fused(
         Kp = K >> 1  # previous level width (0 at the root)
         pos, histC = fused_level(
             bins, pos, gh, st.ptab, K=K, Kp=Kp, B=B, d=d, pallas=pallas,
-            onehot=onehot,
+            onehot=onehot, axis_name=cfg.axis_name,
         )  # histC: [F, 2K, B], missing excluded
         if cfg.axis_name is not None:
             histC = jax.lax.psum(histC, cfg.axis_name)

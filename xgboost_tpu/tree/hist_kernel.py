@@ -226,8 +226,19 @@ def _level_kernel(bins_ref, pos_ref, gh_ref, ptab_ref, pos_out, hist_ref,
         hist_ref[f, :, :] += out[:2 * K] + out[2 * K:]
 
 
-@functools.partial(jax.jit, static_argnames=("K", "Kp", "B", "d", "tr"))
-def _fused_level_pallas(bins, pos, gh, ptab, *, K, Kp, B, d, tr=TR):
+def _vma_struct(shape, dtype, axes):
+    """ShapeDtypeStruct with the varying-manual-axes annotation shard_map's
+    check_vma demands of pallas_call outputs (per-shard kernel results vary
+    over the row axis; the psum above the kernel restores invariance)."""
+    if axes:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=frozenset(axes))
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("K", "Kp", "B", "d", "tr", "vma"))
+def _fused_level_pallas(bins, pos, gh, ptab, *, K, Kp, B, d, tr=TR,
+                        vma=()):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -256,8 +267,8 @@ def _fused_level_pallas(bins, pos, gh, ptab, *, K, Kp, B, d, tr=TR):
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((n, 1), jnp.int32),
-            jax.ShapeDtypeStruct((F, 2 * K, B), jnp.float32),
+            _vma_struct((n, 1), jnp.int32, vma),
+            _vma_struct((F, 2 * K, B), jnp.float32, vma),
         ],
         interpret=_INTERPRET,
     )(bins, pos, gh, ptab)
@@ -293,9 +304,10 @@ def _hoisted_kernel(bins_ref, oh_ref, pos_ref, gh_ref, ptab_ref, pos_out,
     hist_ref[:, :] += out[: 2 * K] + out[2 * K:]
 
 
-@functools.partial(jax.jit, static_argnames=("K", "Kp", "B", "d", "tr"))
+@functools.partial(jax.jit,
+                   static_argnames=("K", "Kp", "B", "d", "tr", "vma"))
 def _hoisted_level_pallas(bins, onehot, pos, gh, ptab, *, K, Kp, B, d,
-                          tr=TR_HOIST):
+                          tr=TR_HOIST, vma=()):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -327,8 +339,8 @@ def _hoisted_level_pallas(bins, onehot, pos, gh, ptab, *, K, Kp, B, d,
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((n, 1), jnp.int32),
-            jax.ShapeDtypeStruct((2 * K, Q), jnp.float32),
+            _vma_struct((n, 1), jnp.int32, vma),
+            _vma_struct((2 * K, Q), jnp.float32, vma),
         ],
         interpret=_INTERPRET,
     )(bins, onehot, pos, gh, ptab)
@@ -414,7 +426,8 @@ def _hoist_tr(Q: int, K: int, F: int) -> int:
 
 
 def fused_level(bins, pos, gh, ptab, *, K, Kp, B, d, pallas: bool,
-                onehot: Optional[jax.Array] = None):
+                onehot: Optional[jax.Array] = None,
+                axis_name: Optional[str] = None):
     """Dispatch: (new pos [n,1] i32, hist [F, 2K, B] f32). ``hist`` excludes
     the missing bin (derive per-feature missing sums as total - sum).
     ``onehot`` (the HBM-resident [n, F*B] int8 expansion) selects the
@@ -422,13 +435,21 @@ def fused_level(bins, pos, gh, ptab, *, K, Kp, B, d, pallas: bool,
     to the in-kernel construction, then to XLA."""
     F = bins.shape[1]
     acc_bytes = F * 2 * K * B * 4
+    vma = (axis_name,) if axis_name is not None else ()
+    if pallas and axis_name is not None:
+        # the decision table is replication-proven (it derives from the
+        # psum'd histogram); the pallas boundary wants operands uniformly
+        # varying, so relax it — a no-op on device
+        ptab = jax.lax.pcast(ptab, (axis_name,), to="varying")
     if pallas and onehot is not None:
         tr = _hoist_tr(F * B, K, F)
         if tr and bins.shape[0] % tr == 0:
             return _hoisted_level_pallas(bins, onehot, pos, gh, ptab,
-                                         K=K, Kp=Kp, B=B, d=d, tr=tr)
+                                         K=K, Kp=Kp, B=B, d=d, tr=tr,
+                                         vma=vma)
     if pallas and F <= _MAX_KERNEL_FEATURES and acc_bytes <= _VMEM_ACC_BUDGET:
-        return _fused_level_pallas(bins, pos, gh, ptab, K=K, Kp=Kp, B=B, d=d)
+        return _fused_level_pallas(bins, pos, gh, ptab, K=K, Kp=Kp, B=B,
+                                   d=d, vma=vma)
     return fused_level_xla(bins, pos, gh, ptab, K=K, Kp=Kp, B=B, d=d)
 
 
