@@ -164,3 +164,59 @@ def test_hoist_gates_agree():
     assert _hoist_tr(50 * 64, 32, 50) > 0
     assert _hoist_tr(50 * 128, 32, 50) > 0
     assert _hoist_tr(50 * 256, 32, 50) == 0
+
+
+def test_kernel_categorical_partition_interpret_mode():
+    """The wide [Kp, 5+B] decision table (is_cat + right-going set) routes
+    rows identically in the REAL kernel body (interpret mode) and the XLA
+    twin partition_apply_xla — pinning the categorical branch of
+    _partition_tile before hardware."""
+    import functools
+
+    from jax.experimental import pallas as pl
+
+    from xgboost_tpu.tree import hist_kernel as hk
+
+    rng = np.random.RandomState(2)
+    n, F, B = 512, 4, 16
+    Kp, K, d = 2, 4, 2
+    bins = jnp.asarray(rng.randint(0, B + 1, size=(n, F)).astype(np.int32))
+    gh = jnp.asarray(rng.randn(n, 2).astype(np.float32))
+    prev_off = (1 << (d - 1)) - 1
+    pos = jnp.asarray(rng.randint(prev_off, prev_off + Kp,
+                                  size=(n, 1)).astype(np.int32))
+    # two split nodes: one numerical, one categorical with a random set
+    sets = rng.rand(Kp, B) < 0.4
+    ptab = np.zeros((Kp, 5 + B), np.float32)
+    ptab[:, 0] = 1.0  # is_split
+    ptab[:, 1] = rng.randint(0, F, Kp)
+    ptab[:, 2] = rng.randint(0, B, Kp)
+    ptab[:, 3] = rng.randint(0, 2, Kp)
+    ptab[:, 4] = [0.0, 1.0]  # node 1 categorical
+    ptab[1, 5:] = sets[1]
+    ptab_j = jnp.asarray(ptab)
+
+    want = hk.partition_apply_xla(bins, pos, ptab_j, Kp=Kp, B=B, d=d)
+
+    kern = functools.partial(hk._level_kernel, K=K, Kp=Kp, F=F, B=B,
+                             prev_offset=prev_off, offset=(1 << d) - 1)
+    pos_new, _ = pl.pallas_call(
+        kern,
+        grid=(2,),
+        in_specs=[
+            pl.BlockSpec((256, F), lambda c: (c, 0)),
+            pl.BlockSpec((256, 1), lambda c: (c, 0)),
+            pl.BlockSpec((256, 2), lambda c: (c, 0)),
+            pl.BlockSpec((Kp, 5 + B), lambda c: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((256, 1), lambda c: (c, 0)),
+            pl.BlockSpec((F, 2 * K, B), lambda c: (0, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, 1), jnp.int32),
+            jax.ShapeDtypeStruct((F, 2 * K, B), jnp.float32),
+        ],
+        interpret=True,
+    )(bins, pos, gh, ptab_j)
+    np.testing.assert_array_equal(np.asarray(pos_new), np.asarray(want))
