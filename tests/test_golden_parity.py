@@ -555,3 +555,51 @@ def test_golden_aft_nloglik_metric():  # test_survival_metric.cu:50
         got = float(m.evaluate(preds, lab, label_lower=lower,
                                label_upper=upper))
         assert got == pytest.approx(want, abs=2e-3), (dist, got, want)
+
+
+def _rank_gpair(name, preds, labels, group_weights, gptr):
+    obj = create_objective(name, None)
+    g, h = obj.get_gradient(jnp.asarray(preds, jnp.float32),
+                            jnp.asarray(labels, jnp.float32),
+                            np.asarray(group_weights, np.float32),
+                            0, group_ptr=np.asarray(gptr))
+    return np.asarray(g), np.asarray(h)
+
+
+def test_golden_rank_pairwise_gpair():  # test_ranking_obj.cc:9
+    g, h = _rank_gpair("rank:pairwise", [0, 0.1, 0, 0.1], [0, 1, 0, 1],
+                       [2.0, 0.0], [0, 2, 4])
+    np.testing.assert_allclose(g, [1.9, -1.9, 0, 0], atol=0.01)
+    np.testing.assert_allclose(h, [1.995, 1.995, 0, 0], atol=0.01)
+    g, h = _rank_gpair("rank:pairwise", [0, 0.1, 0, 0.1], [0, 1, 0, 1],
+                       [1.0, 1.0], [0, 2, 4])
+    np.testing.assert_allclose(g, [0.95, -0.95, 0.95, -0.95], atol=0.01)
+    np.testing.assert_allclose(h, [0.9975] * 4, atol=0.01)
+    # same labels -> zero gradients (test_ranking_obj.cc:59)
+    g, h = _rank_gpair("rank:pairwise", [0, 0.1, 0, 0.1], [1, 1, 1, 1],
+                       [2.0, 0.0], [0, 2, 4])
+    np.testing.assert_allclose(g, 0.0, atol=1e-6)
+    np.testing.assert_allclose(h, 0.0, atol=1e-6)
+
+
+def test_golden_rank_ndcg_gpair():  # test_ranking_obj.cc:79
+    g, h = _rank_gpair("rank:ndcg", [0, 0.1, 0, 0.1], [0, 1, 0, 1],
+                       [2.0, 0.0], [0, 2, 4])
+    np.testing.assert_allclose(g, [0.7, -0.7, 0, 0], atol=0.01)
+    np.testing.assert_allclose(h, [0.74, 0.74, 0, 0], atol=0.01)
+    g, h = _rank_gpair("rank:ndcg", [0, 0.1, 0, 0.1], [0, 1, 0, 1],
+                       [1.0, 1.0], [0, 2, 4])
+    np.testing.assert_allclose(g, [0.35, -0.35, 0.35, -0.35], atol=0.01)
+    np.testing.assert_allclose(h, [0.368] * 4, atol=0.01)
+
+
+def test_golden_rank_map_gpair():  # test_ranking_obj.cc:108
+    g, h = _rank_gpair("rank:map", [0, 0.1, 0, 0.1], [0, 1, 0, 1],
+                       [2.0, 0.0], [0, 2, 4])
+    np.testing.assert_allclose(g, [0.95, -0.95, 0, 0], atol=0.01)
+    np.testing.assert_allclose(h, [0.9975, 0.9975, 0, 0], atol=0.01)
+    g, h = _rank_gpair("rank:map", [0, 0.1, 0, 0.1], [0, 1, 0, 1],
+                       [1.0, 1.0], [0, 2, 4])
+    np.testing.assert_allclose(g, [0.475, -0.475, 0.475, -0.475],
+                               atol=0.01)
+    np.testing.assert_allclose(h, [0.4988] * 4, atol=0.01)

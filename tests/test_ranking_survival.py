@@ -243,15 +243,21 @@ def test_rank_map_deltas_match_reference_oracle():
     y = rng.randint(0, 2, n).astype(np.float32)
 
     # oracle gradient: all-pairs RankNet lambdas weighted by MAP deltas
+    # times the reference sampler's expectation weight
+    # 1/n_opp(i) + 1/n_opp(j) (rank_obj.cu:97-127 two-ended uniform draws)
     g_oracle = np.zeros(n)
     for g in range(len(sizes)):
         lo, hi = gptr[g], gptr[g + 1]
         deltas = _map_delta_oracle(p[lo:hi], y[lo:hi])
+        yg = y[lo:hi]
+        opp = np.array([(yg != yg[i]).sum() for i in range(sizes[g])],
+                       float)
+        opp = np.maximum(opp, 1.0)
         for i in range(sizes[g]):
             for j in range(sizes[g]):
                 if y[lo + i] > y[lo + j]:
                     rho = 1.0 / (1.0 + np.exp(p[lo + i] - p[lo + j]))
-                    lamv = rho * deltas[i, j]
+                    lamv = rho * deltas[i, j] * (1.0 / opp[i] + 1.0 / opp[j])
                     g_oracle[lo + i] -= lamv
                     g_oracle[lo + j] += lamv
 
@@ -262,9 +268,9 @@ def test_rank_map_deltas_match_reference_oracle():
                             3, max(sizes), "map")
     np.testing.assert_allclose(np.asarray(g_pad), g_oracle, atol=1e-5)
 
-    # sampled path: each unordered pair is drawn from both ends, so
-    # E[sampled grad] = (2 * n_pair / group_size) * all-pairs grad —
-    # rescale per group, then many draws must closely recover the oracle
+    # sampled path: the estimator now carries the reference-expectation
+    # weights internally, so many draws must recover the oracle DIRECTLY
+    # (no rescaling)
     starts = np.asarray(gptr[:-1], np.int32)
     n_pair = 256
     g_s, _ = _lambda_grad_sampled(
@@ -272,8 +278,7 @@ def test_rank_map_deltas_match_reference_oracle():
         jnp.asarray(starts[group_of]),
         jnp.asarray(np.asarray(sizes, np.int32)[group_of]),
         jax.random.PRNGKey(0), 3, n_pair, "map")
-    size_row = np.asarray(sizes)[group_of].astype(float)
-    gs = np.asarray(g_s) * size_row / (2.0 * n_pair)
+    gs = np.asarray(g_s)
     corr = np.corrcoef(gs, g_oracle)[0, 1]
     assert corr > 0.98, corr
     rel_err = np.linalg.norm(gs - g_oracle) / np.linalg.norm(g_oracle)

@@ -75,6 +75,18 @@ def _lambda_grad(
         s_diff = m[:, None] - m[None, :]
         # RankNet lambda: sigmoid(-(si - sj)) for positive pairs
         rho = jax.nn.sigmoid(-s_diff)
+        # the reference samples each doc's opponents uniformly among
+        # DIFFERENT-label docs from both pair ends (rank_obj.cu:97-127,
+        # scale 1/num_pairsample); its expectation gives every unordered
+        # pair the weight 1/n_opp(i) + 1/n_opp(j) — the all-pairs path
+        # applies that expectation exactly
+        vf = v.astype(m.dtype)
+        vcount = vf.sum()
+        same_cnt = ((y[:, None] == y[None, :]) & v[:, None]
+                    & v[None, :]).astype(m.dtype).sum(axis=1)
+        opp = jnp.maximum(vcount - same_cnt, 1.0)
+        end_w = jnp.where(v, 1.0 / opp, 0.0)
+        samp_w = end_w[:, None] + end_w[None, :]  # [S, S]
         if scheme == "ndcg":
             # delta-NDCG weighting: |gain_i - gain_j| * |1/log2(ri+2) - 1/log2(rj+2)| / IDCG
             order = jnp.argsort(-jnp.where(v, m, -jnp.inf))
@@ -121,8 +133,11 @@ def _lambda_grad(
             w_pair = jnp.where(pair, delta, 0.0)
         else:  # pairwise: unit delta
             w_pair = jnp.where(pair, 1.0, 0.0)
+        w_pair = w_pair * samp_w
         lam = rho * w_pair  # [S, S] contribution for (i above j)
-        hessian = rho * (1.0 - rho) * w_pair
+        # reference hessian per pair end: 2 * w * p * (1 - p)
+        # (rank_obj.cu:142 'gpair[...] += GradientPair(g*w, 2.0f*w*h)')
+        hessian = 2.0 * rho * (1.0 - rho) * w_pair
         grad = -lam.sum(axis=1) + lam.sum(axis=0)  # winners pushed up, losers down
         hess = hessian.sum(axis=1) + hessian.sum(axis=0)
         return grad, jnp.maximum(hess, 1e-16)
@@ -180,6 +195,26 @@ def _lambda_grad_sampled(
     y_j = label[j]
     valid = label[:, None] != y_j
 
+    # per-row different-label opponent count (for the reference sampler's
+    # expectation weights 1/n_opp(i) + 1/n_opp(j), rank_obj.cu:97-127):
+    # run-lengths of equal (group, label) from one lexsort
+    lorder2 = jnp.lexsort((label, group_of))
+    gs, ys2 = group_of[lorder2], label[lorder2]
+    new_run = jnp.concatenate(
+        [jnp.ones((1,), bool),
+         (gs[1:] != gs[:-1]) | (ys2[1:] != ys2[:-1])])
+    run_id = jnp.cumsum(new_run) - 1
+    run_cnt = jax.ops.segment_sum(jnp.ones((n,), margin.dtype), run_id,
+                                  num_segments=n)
+    same_cnt = jnp.zeros((n,), margin.dtype).at[lorder2].set(
+        run_cnt[run_id])
+    opp = jnp.maximum(group_size.astype(margin.dtype) - same_cnt, 1.0)
+    end_w = 1.0 / opp  # [n]
+    # scale so E[update] equals the reference sampler's expectation: each
+    # unordered pair is hit from BOTH ends ~n_pair/size times here
+    samp_w = (group_size.astype(margin.dtype)[:, None]
+              * (end_w[:, None] + end_w[j]) / (2.0 * n_pair))
+
     # orient each pair: hi = higher label
     i_is_hi = label[:, None] > y_j
     s_hi = jnp.where(i_is_hi, margin[:, None], m_j)
@@ -234,8 +269,10 @@ def _lambda_grad_sampled(
         w_pair = jnp.where(valid, delta, 0.0)
     else:
         w_pair = jnp.where(valid, 1.0, 0.0)
+    w_pair = w_pair * samp_w
     lam = rho * w_pair  # pushes hi up, lo down
-    hes = jnp.maximum(rho * (1.0 - rho), 1e-16) * w_pair
+    # reference hessian per pair end: 2 * w * p * (1-p) (rank_obj.cu:142)
+    hes = jnp.maximum(2.0 * rho * (1.0 - rho), 1e-16) * w_pair
 
     sign_i = jnp.where(i_is_hi, -1.0, 1.0)  # hi gets -lambda
     grad = (sign_i * lam).sum(axis=1)
@@ -277,9 +314,13 @@ class _LambdaRankBase(ObjFunction):
                 margin, label, jnp.asarray(group_of), jnp.asarray(rank_in_group),
                 n_groups, max_size, self.scheme,
             )
-        # per-group query weights (reference: weights are per-group for ranking)
+        # per-group query weights, normalized so the group-weight SUM drops
+        # out (reference ComputeWeightNormalizationFactor: ngroup / sum_w)
         if weight is not None and len(weight) == n_groups:
-            w_row = jnp.asarray(np.repeat(np.asarray(weight), sizes))
+            w_np = np.asarray(weight, np.float64)
+            norm = n_groups / max(float(w_np.sum()), 1e-30)
+            w_row = jnp.asarray(np.repeat(w_np * norm, sizes)
+                                .astype(np.float32))
             grad, hess = grad * w_row, hess * w_row
         elif weight is not None and len(weight) == n:
             grad, hess = grad * weight, hess * weight
