@@ -662,3 +662,23 @@ def test_feature_names_from_any_cache_and_fmap(tmp_path):
     h = bst.get_split_value_histogram("beta", fmap=str(fmap),
                                       as_pandas=False)
     assert h.shape[1] == 2
+
+
+def test_update_many_scan_with_num_parallel_tree():
+    """The whole-chunk scan now handles num_parallel_tree > 1 (boosted
+    random forests): predictions must match per-round updates exactly and
+    slicing semantics must see num_parallel_tree trees per round."""
+    X, y = _data(1500, 5, seed=12)
+    params = {"objective": "binary:logistic", "max_depth": 3,
+              "num_parallel_tree": 3, "subsample": 0.6, "seed": 9}
+    d1 = xgb.DMatrix(X, label=y)
+    b1 = xgb.Booster(params, [d1])
+    for i in range(4):
+        b1.update(d1, i)
+    d2 = xgb.DMatrix(X, label=y)
+    b2 = xgb.Booster(params, [d2])
+    b2.update_many(d2, 0, 4, chunk=2)
+    assert b2._gbm.model.num_trees == 12
+    assert b2._gbm.model.tree_info == b1._gbm.model.tree_info
+    np.testing.assert_allclose(b1.predict(d1), b2.predict(d2),
+                               rtol=1e-5, atol=1e-6)

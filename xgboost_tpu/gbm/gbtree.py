@@ -424,16 +424,20 @@ class GBTreeModel:
         self.tree_info.append(group)
         self._stacked = None
 
-    def add_device_chunk(self, stacked: GrownTree, R: int, K: int,
-                         eta: float, max_depth: int) -> None:
-        """Append a whole scan-chunk ([R, K, N] stacked heap arrays) as R*K
-        trees WITHOUT slicing per-tree device arrays (see _PendingChunk).
-        Tree order matches the per-round path: r-major, group k inner."""
-        chunk = _PendingChunk(stacked, R, K, eta, max_depth)
+    def add_device_chunk(self, stacked: GrownTree, R: int,
+                         groups_per_round, eta: float,
+                         max_depth: int) -> None:
+        """Append a whole scan-chunk ([R, T, N] stacked heap arrays, T
+        trees per round) as R*T trees WITHOUT slicing per-tree device
+        arrays (see _PendingChunk). ``groups_per_round`` lists each tree
+        slot's output group in the per-round order (group-major, parallel
+        trees inner — matching boost_one_round / BoostNewTrees)."""
+        T = len(groups_per_round)
+        chunk = _PendingChunk(stacked, R, T, eta, max_depth)
         for r in range(R):
-            for k in range(K):
-                self._entries.append(_ChunkRef(chunk, r, k))
-                self.tree_info.append(k)
+            for idx, grp in enumerate(groups_per_round):
+                self._entries.append(_ChunkRef(chunk, r, idx))
+                self.tree_info.append(int(grp))
         self._stacked = None
 
     def add_device_alloc_chunk(self, alloc_stacked, keep, leaf_value,
@@ -565,13 +569,13 @@ def round_seed_py(seed: int, iteration: int, k: int = 0,
     return (seed * 1000003 + iteration * 131 + k * 17 + ptree) & 0x7FFFFFFF
 
 
-def round_seed_traced(seed_base_u32, i, k: int = 0):
+def round_seed_traced(seed_base_u32, i, k: int = 0, ptree: int = 0):
     """Traced twin of ``round_seed_py`` for scan bodies: ``seed_base_u32``
     is uint32((seed * 1000003) & 0xFFFFFFFF); the 31-bit mask reads only
     low bits, which uint32 arithmetic preserves, so the two formulas agree
     bit for bit."""
     return (seed_base_u32 + i.astype(jnp.uint32) * jnp.uint32(131)
-            + jnp.uint32(k * 17)) & jnp.uint32(0x7FFFFFFF)
+            + jnp.uint32(k * 17 + ptree)) & jnp.uint32(0x7FFFFFFF)
 
 
 def _mesh_active() -> bool:
@@ -599,10 +603,10 @@ def _obj_fingerprint(obj) -> tuple:
 
 @functools.partial(jax.jit,
                    static_argnames=("obj", "obj_fp", "cfg", "n", "n_pad",
-                                    "n_groups"))
+                                    "n_groups", "n_parallel"))
 def _scan_rounds_impl(binsf, label, weight, m_pad, iters, cut_vals, eta,
                       gamma, fw, seed_base, onehot=None, *, obj, obj_fp,
-                      cfg, n, n_pad, n_groups):
+                      cfg, n, n_pad, n_groups, n_parallel=1):
     """Multi-round boosting as one program: scan body = gradient -> fused
     tree(s) -> margin update (one tree per output group, like DoBoost's
     per-group gradient slicing, gbtree.cc:219). Cache key includes the
@@ -622,14 +626,17 @@ def _scan_rounds_impl(binsf, label, weight, m_pad, iters, cut_vals, eta,
         for k in range(K):
             gk = pad0(g[:, k] if g.ndim == 2 else g)
             hk = pad0(h[:, k] if h.ndim == 2 else h)
-            # bit-identical to boost_one_round's python-int key formula:
-            # the 31-bit mask reads only low bits, which uint32 keeps
-            seed = round_seed_traced(seed_base, i, k)
-            key = jax.random.PRNGKey(seed.astype(jnp.int32))
-            t = grow_tree_fused(binsf, gk, hk, cut_vals, key, eta, gamma,
-                                cfg, feature_weights=fw, onehot=onehot)
-            m_pad = m_pad.at[:, k].add(t.delta)
-            trees.append(t._replace(delta=jnp.zeros((0,), jnp.float32)))
+            for pt in range(n_parallel):
+                # bit-identical to boost_one_round's python-int key
+                # formula: the 31-bit mask reads only low bits
+                seed = round_seed_traced(seed_base, i, k, pt)
+                key = jax.random.PRNGKey(seed.astype(jnp.int32))
+                t = grow_tree_fused(binsf, gk, hk, cut_vals, key, eta,
+                                    gamma, cfg, feature_weights=fw,
+                                    onehot=onehot)
+                m_pad = m_pad.at[:, k].add(t.delta)
+                trees.append(
+                    t._replace(delta=jnp.zeros((0,), jnp.float32)))
         stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *trees)
         return m_pad, stacked
 
@@ -1315,9 +1322,12 @@ class GBTree:
         path with a scan-safe (jax-traceable, groupless-state) objective;
         one tree per output group per round."""
         tp = self.train_param
+        npt_ok = self.gbtree_param.num_parallel_tree == 1 or (
+            tp.grow_policy != "lossguide" and not _mesh_active()
+        )
         return (
             self.name == "gbtree"
-            and self.gbtree_param.num_parallel_tree == 1
+            and npt_ok
             and not self._is_update_process
             and getattr(obj, "scan_safe", False)
             and not tuple(getattr(binned, "categorical", ()))
@@ -1400,15 +1410,20 @@ class GBTree:
             # margin cache, evals, and predictions are process-local
             m_pad = local_rows(m_pad)
         else:
+            npt = self.gbtree_param.num_parallel_tree
             m_pad, stacked = _scan_rounds_impl(
                 binsf, label, weight_j, m_pad, iters, cut_vals, eta, gamma,
                 fw, jnp.uint32(seed_base), binned.fused_onehot(tp.max_depth),
                 obj=obj,
                 obj_fp=_obj_fingerprint(obj), cfg=cfg, n=n, n_pad=n_pad,
-                n_groups=K,
+                n_groups=K, n_parallel=npt,
             )
-        self.model.add_device_chunk(stacked, num_rounds, K, tp.eta,
-                                    tp.max_depth)
+            groups = [k for k in range(K) for _ in range(npt)]
+            self.model.add_device_chunk(stacked, num_rounds, groups,
+                                        tp.eta, tp.max_depth)
+            return m_pad[:n]
+        self.model.add_device_chunk(stacked, num_rounds, list(range(K)),
+                                    tp.eta, tp.max_depth)
         return m_pad[:n]
 
     def _scan_lossguide(self, binned, obj, label, weight, margin,
