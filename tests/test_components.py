@@ -682,3 +682,24 @@ def test_update_many_scan_with_num_parallel_tree():
     assert b2._gbm.model.tree_info == b1._gbm.model.tree_info
     np.testing.assert_allclose(b1.predict(d1), b2.predict(d2),
                                rtol=1e-5, atol=1e-6)
+
+
+def test_booster_feature_properties_and_config_io():
+    """Booster.feature_names/feature_types properties and
+    save_config/load_config (reference core.py properties +
+    XGBoosterSaveJsonConfig)."""
+    X, y = _data(300, 3)
+    d = xgb.DMatrix(X, label=y, feature_names=["a", "b", "c"])
+    bst = xgb.train({"objective": "binary:logistic", "max_depth": 2}, d, 2,
+                    verbose_eval=False)
+    assert bst.feature_names == ["a", "b", "c"]
+    bst.feature_names = ["x", "y", "z"]
+    assert bst.feature_names == ["x", "y", "z"]
+    assert set(bst.get_score()) <= {"x", "y", "z"}
+    cfg = bst.save_config()
+    j = json.loads(cfg)
+    assert j["learner"]["objective"]["name"] == "binary:logistic"
+    assert j["learner"]["gradient_booster"]["name"] == "gbtree"
+    b2 = xgb.Booster()
+    b2.load_config(cfg)
+    assert b2.lparam.objective == "binary:logistic"

@@ -672,6 +672,68 @@ class Booster:
     def attributes(self) -> Dict[str, str]:
         return dict(self.attributes_)
 
+    # ------------------------------------------------------------------
+    # feature metadata properties + config IO (reference core.py
+    # Booster.feature_names/feature_types, save_config/load_config —
+    # XGBoosterSaveJsonConfig / learner.cc:SaveConfig)
+    # ------------------------------------------------------------------
+    @property
+    def feature_names(self) -> Optional[List[str]]:
+        return self._feature_meta()[0] or None
+
+    @feature_names.setter
+    def feature_names(self, names) -> None:
+        self._loaded_feature_names = list(names) if names else []
+        for d in self._cache_refs.values():
+            d.feature_names = list(names) if names else None
+
+    @property
+    def feature_types(self) -> Optional[List[str]]:
+        return self._feature_meta()[1] or None
+
+    @feature_types.setter
+    def feature_types(self, types) -> None:
+        self._loaded_feature_types = list(types) if types else []
+
+    def save_config(self) -> str:
+        """JSON string of the learner's configuration (reference
+        XGBoosterSaveJsonConfig). Covers the learner-level ParamSet, the
+        booster/tree params, and the objective — enough for load_config to
+        reconstruct an equivalently-configured Booster."""
+        self._configure()
+        cfg = {
+            "version": list(_VERSION),
+            "learner": {
+                "learner_train_param": self.lparam.to_dict(),
+                "gradient_booster": {
+                    "name": self._gbm.name,
+                    "params": dict(self._extra_params),
+                },
+                "objective": {"name": self._obj.name},
+            },
+        }
+        return json.dumps(cfg)
+
+    def load_config(self, config: str) -> None:
+        c = json.loads(config)
+        learner = c.get("learner", {})
+        self._apply_params(dict(learner.get("learner_train_param", {})))
+        gb = learner.get("gradient_booster", {})
+        if gb.get("name"):
+            self._apply_params({"booster": gb["name"]})
+        self._apply_params(dict(gb.get("params", {})))
+        obj = learner.get("objective", {})
+        if obj.get("name"):
+            self._apply_params({"objective": obj["name"]})
+        # rebuild lazily with the new configuration
+        if self._gbm is not None:
+            for k, v in {**gb.get("params", {})}.items():
+                try:
+                    self._gbm.set_param(k, v)
+                except Exception:
+                    pass
+        self._metrics = []
+
     def get_split_value_histogram(self, feature: str, fmap: str = "",
                                   bins: Optional[int] = None,
                                   as_pandas: bool = True):
