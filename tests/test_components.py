@@ -703,3 +703,27 @@ def test_booster_feature_properties_and_config_io():
     b2 = xgb.Booster()
     b2.load_config(cfg)
     assert b2.lparam.objective == "binary:logistic"
+
+
+def test_sklearn_linear_coef_intercept_evals_result():
+    """coef_/intercept_ for gblinear (reference sklearn.py properties),
+    AttributeError for tree boosters, evals_result() accessor."""
+    rng = np.random.RandomState(0)
+    X = rng.randn(500, 3).astype(np.float32)
+    y = (1.5 * X[:, 0] - 2.0 * X[:, 1] + 0.5).astype(np.float32)
+    from xgboost_tpu.sklearn import XGBClassifier, XGBRegressor
+
+    m = XGBRegressor(booster="gblinear", n_estimators=40, learning_rate=0.5,
+                     reg_lambda=0.0, base_score=0.5)
+    m.fit(X, y)
+    np.testing.assert_allclose(m.coef_, [1.5, -2.0, 0.0], atol=0.1)
+    # base_score absorbs the constant: the bias weight itself is ~0
+    assert abs(float(m.intercept_[0]) + 0.5 - 0.5) < 0.1
+    assert m.get_num_boosting_rounds() == 40
+
+    c = XGBClassifier(n_estimators=3, max_depth=2)
+    yb = (y > 0).astype(np.float32)
+    c.fit(X, yb, eval_set=[(X, yb)], verbose=False)
+    assert "validation_0" in c.evals_result()
+    with pytest.raises(AttributeError):
+        c.coef_

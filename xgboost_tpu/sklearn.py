@@ -185,6 +185,32 @@ class XGBModel:
             raise ValueError("need to call fit first")
         return self._Booster
 
+    def evals_result(self) -> Dict:
+        """Evaluation history recorded during fit (reference
+        sklearn.py:evals_result)."""
+        return getattr(self, "evals_result_", {})
+
+    def get_num_boosting_rounds(self) -> int:
+        return self.n_estimators
+
+    def _linear_weights(self) -> np.ndarray:
+        gbm = self.get_booster()._gbm
+        if getattr(gbm, "name", "") != "gblinear" or gbm.weights is None:
+            raise AttributeError(
+                "coef_/intercept_ are only defined for booster='gblinear' "
+                "(reference sklearn.py raises the same way)"
+            )
+        return np.asarray(gbm.weights)  # [F+1, K], bias last row
+
+    @property
+    def coef_(self) -> np.ndarray:
+        w = self._linear_weights()[:-1]
+        return w[:, 0] if w.shape[1] == 1 else w.T
+
+    @property
+    def intercept_(self) -> np.ndarray:
+        return self._linear_weights()[-1]
+
     def save_model(self, fname: str) -> None:
         self.get_booster().save_model(fname)
 
