@@ -14,6 +14,29 @@ from .utils.timer import profiler_context  # noqa: F401
 from .data.external import ExternalMemoryQuantileDMatrix  # noqa: F401
 from .learner import Booster  # noqa: F401
 from .training import cv, train  # noqa: F401
+from .plotting import plot_importance, plot_tree, to_graphviz  # noqa: F401
+from .data.iterator import DataIter  # noqa: F401
+
+
+def build_info() -> dict:
+    """Build/runtime facts (reference: xgboost.build_info — compiler and
+    feature flags; here the backend and kernel availability)."""
+    import jax
+
+    from .native import get_pagecache_lib
+    from .tree.hist_kernel import use_pallas
+
+    try:
+        backend = jax.default_backend()
+    except Exception:  # pragma: no cover - backend init failure
+        backend = "uninitialized"
+    return {
+        "backend": backend,
+        "pallas_kernels": use_pallas(),
+        "native_pagecache": get_pagecache_lib() is not None,
+        "devices": len(jax.devices()) if backend != "uninitialized" else 0,
+    }
+
 from . import callback  # noqa: F401
 from . import objective  # noqa: F401  (registers objectives)
 from . import metric  # noqa: F401  (registers metrics)
