@@ -727,3 +727,25 @@ def test_sklearn_linear_coef_intercept_evals_result():
     assert "validation_0" in c.evals_result()
     with pytest.raises(AttributeError):
         c.coef_
+
+
+def test_dmatrix_surface_completions(tmp_path):
+    """set_info / get_uint_info / get_group / get_data / save_binary
+    round-trip (reference core.py DMatrix surface)."""
+    import scipy.sparse as sp
+
+    X, y = _data(120, 4)
+    d = xgb.DMatrix(X)
+    d.set_info(label=y, weight=np.ones(120, np.float32), group=[60, 60],
+               feature_names=["a", "b", "c", "dd"])
+    assert d.get_label().shape == (120,)
+    np.testing.assert_array_equal(d.get_group(), [60, 60])
+    assert d.get_uint_info("group_ptr").tolist() == [0, 60, 120]
+    csr = d.get_data()
+    assert sp.issparse(csr) and csr.shape == (120, 4)
+    np.testing.assert_allclose(csr.toarray(), np.nan_to_num(X), atol=1e-6)
+    fp = str(tmp_path / "m.buffer.npz")
+    d.save_binary(fp)
+    d2 = xgb.DMatrix(fp)
+    assert d2.num_row() == 120 and d2.feature_names == ["a", "b", "c", "dd"]
+    np.testing.assert_allclose(d2.get_label(), y)

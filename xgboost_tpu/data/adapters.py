@@ -130,8 +130,21 @@ def dispatch_data(
         uri = str(data)
         path, _, fmt = uri.partition("?format=")
         if not fmt:
-            fmt = "csv" if path.endswith(".csv") else "libsvm"
-        if fmt == "csv":
+            if path.endswith(".csv"):
+                fmt = "csv"
+            elif path.endswith((".buffer", ".npz")):
+                fmt = "binary"
+            else:
+                fmt = "libsvm"
+        if fmt == "binary":
+            # DMatrix.save_binary round-trip (reference .buffer files)
+            with np.load(path, allow_pickle=False) as z:
+                X = z["data"].astype(np.float32)
+                label = z["label"] if z["label"].size else None
+                qid = None
+                names = [str(x) for x in z["feature_names"]]
+                feature_names = names or None
+        elif fmt == "csv":
             X, label = load_csv(path)
         else:
             X, label, qid = load_svmlight(path)

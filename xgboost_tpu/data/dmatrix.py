@@ -159,6 +159,74 @@ class DMatrix:
         else:
             raise ValueError(f"unknown uint field: {field!r}")
 
+    def get_uint_info(self, field: str) -> np.ndarray:
+        if field in ("group_ptr", "group"):
+            gp = self.info.group_ptr
+            return (np.asarray(gp, np.uint32) if gp is not None
+                    else np.array([], np.uint32))
+        raise ValueError(f"unknown uint field: {field!r}")
+
+    def set_info(self, *, label=None, weight=None, base_margin=None,
+                 group=None, qid=None, label_lower_bound=None,
+                 label_upper_bound=None, feature_names=None,
+                 feature_types=None, feature_weights=None) -> None:
+        """Bulk metadata setter (reference core.py DMatrix.set_info)."""
+        if label is not None:
+            self.set_label(label)
+        if weight is not None:
+            self.set_weight(weight)
+        if base_margin is not None:
+            self.set_base_margin(base_margin)
+        if group is not None:
+            self.set_group(group)
+        if qid is not None:
+            self.info.group_ptr = _group_ptr_from_qid(
+                np.asarray(qid))
+        if label_lower_bound is not None:
+            self.set_float_info("label_lower_bound", label_lower_bound)
+        if label_upper_bound is not None:
+            self.set_float_info("label_upper_bound", label_upper_bound)
+        if feature_weights is not None:
+            self.set_float_info("feature_weights", feature_weights)
+        if feature_names is not None:
+            self.feature_names = feature_names
+        if feature_types is not None:
+            self.info.feature_types = list(feature_types)
+
+    def get_group(self) -> np.ndarray:
+        """Per-group sizes (inverse of set_group)."""
+        gp = self.info.group_ptr
+        if gp is None:
+            return np.array([], np.int64)
+        return np.diff(np.asarray(gp, np.int64))
+
+    def get_data(self):
+        """Feature matrix as scipy CSR (reference DMatrix.get_data)."""
+        import scipy.sparse as sp
+
+        if self._sparse is not None and self._data is None:
+            st = self._sparse
+            return sp.csr_matrix(
+                (np.asarray(st.values), np.asarray(st.indices),
+                 np.asarray(st.indptr)),
+                shape=(self.num_row(), self.num_col()))
+        X = np.asarray(self.data)
+        mask = ~np.isnan(X)
+        return sp.csr_matrix(np.where(mask, X, 0.0) * mask)
+
+    def save_binary(self, fname, silent: bool = True) -> None:
+        """Persist data + metadata for fast reload via ``DMatrix(fname)``
+        (the reference's .buffer files; here an npz container)."""
+        label = self.info.label
+        np.savez(
+            fname,
+            data=np.asarray(self.data, np.float32),
+            label=(np.asarray(label, np.float32) if label is not None
+                   else np.array([], np.float32)),
+            feature_names=np.asarray(
+                [str(n) for n in (self.feature_names or [])]),
+        )
+
     def set_label(self, label: Any) -> None:
         self.info.label = np.asarray(label, dtype=np.float32).reshape(-1)
 
