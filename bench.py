@@ -231,7 +231,9 @@ def _run_configs(args, suffix: str, final: dict) -> None:
             "max_depth": args.max_depth,
             "max_bin": max_bin,
             "eta": 0.1,
-            "verbosity": 1,
+            # INFO level so the session log records which kernel path ran
+            # (e.g. the hoisted one-hot activation line)
+            "verbosity": 2,
         }
 
     def set_final(rows, done, measured, bin_suffix):
