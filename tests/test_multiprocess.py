@@ -51,6 +51,16 @@ with mesh_context(mesh):
 bst.save_model(os.path.join(outdir, f"model_rank{rank}.json"))
 pred = bst.predict(xgb.DMatrix(X[lo:hi]))
 np.save(os.path.join(outdir, f"pred_rank{rank}.npy"), pred)
+
+# the rabit/collective compatibility shim, across real processes
+from xgboost_tpu import collective
+
+assert collective.get_world_size() == 2
+assert collective.get_rank() == rank
+s = collective.allreduce(np.array([float(rank + 1)]), collective.Op.SUM)
+assert float(s[0]) == 3.0, s
+m = collective.allreduce(np.array([float(rank)]), collective.Op.MAX)
+assert float(m[0]) == 1.0, m
 print(f"rank {rank} done", flush=True)
 """
 

@@ -38,6 +38,8 @@ def build_info() -> dict:
     }
 
 from . import callback  # noqa: F401
+from . import collective  # noqa: F401
+from . import collective as rabit  # noqa: F401  (legacy alias)
 from . import objective  # noqa: F401  (registers objectives)
 from . import metric  # noqa: F401  (registers metrics)
 from .gbm import GBTree, Dart, GBLinear  # noqa: F401

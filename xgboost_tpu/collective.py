@@ -1,0 +1,95 @@
+"""Collective-communication compatibility shim (reference:
+``python-package/xgboost/rabit.py`` and its successor
+``xgboost/collective.py`` — init/finalize, rank/world queries, allreduce,
+broadcast, tracker print).
+
+There is no rabit ring here: JAX's single-controller runtime IS the
+communicator (``jax.distributed`` for membership, mesh collectives for
+the hot loop — ``docs/distributed.md``). This module keeps the reference
+API shape working for ported user code: queries map onto
+``jax.process_index/process_count``, ``allreduce`` runs a psum over a
+1-axis mesh of all devices, and ``init``/``finalize`` are no-ops when the
+runtime is already up (the common case under ``init_distributed``).
+"""
+
+from __future__ import annotations
+
+from enum import IntEnum
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["Op", "init", "finalize", "get_rank", "get_world_size",
+           "is_distributed", "allreduce", "broadcast", "communicator_print",
+           "get_processor_name", "tracker_print", "version_number"]
+
+
+class Op(IntEnum):
+    """Reduction ops (reference collective.py Op enum)."""
+
+    MAX = 0
+    MIN = 1
+    SUM = 2
+
+
+def init(**args) -> None:
+    """No-op when the JAX runtime is already initialized (the reference's
+    rabit.init role is played by ``parallel.init_distributed``)."""
+
+
+def finalize() -> None:
+    """No-op: the JAX distributed runtime outlives training."""
+
+
+def get_rank() -> int:
+    import jax
+
+    return jax.process_index()
+
+
+def get_world_size() -> int:
+    import jax
+
+    return jax.process_count()
+
+
+def is_distributed() -> bool:
+    return get_world_size() > 1
+
+
+def get_processor_name() -> str:
+    import socket
+
+    return socket.gethostname()
+
+
+def allreduce(data: np.ndarray, op: int = Op.SUM) -> np.ndarray:
+    """AllReduce with one contribution per PROCESS (the reference's rabit
+    semantics): allgather each process's value through the distributed
+    runtime, reduce on host. Identity when single-process."""
+    arr = np.asarray(data)
+    if get_world_size() == 1:
+        return arr
+    from jax.experimental import multihost_utils
+
+    gathered = np.asarray(multihost_utils.process_allgather(arr))  # [P,...]
+    red = {Op.SUM: np.sum, Op.MAX: np.max, Op.MIN: np.min}[Op(op)]
+    return red(gathered, axis=0)
+
+
+def broadcast(data, root: int):
+    """Reference collective.py:broadcast — with a single controller every
+    process already holds identical python values; returns ``data``."""
+    return data
+
+
+def communicator_print(msg: str) -> None:
+    if get_rank() == 0:
+        print(msg, flush=True)
+
+
+tracker_print = communicator_print
+
+
+def version_number() -> int:
+    return 0
