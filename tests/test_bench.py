@@ -7,12 +7,25 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_watchdog_env():
+    """In-process bench.main() calls write the absolute watchdog deadline
+    into os.environ (it must survive the CPU-fallback re-exec); scrub it
+    so later tests/subprocesses don't inherit a stale deadline."""
+    yield
+    os.environ.pop("XGBTPU_BENCH_DEADLINE_AT", None)
+    os.environ.pop("XGBTPU_BENCH_CPU_FALLBACK", None)
 
 
 def test_bench_produces_json_line():
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("XGBTPU_BENCH_DEADLINE_AT", None)  # in-process tests may set it
     env["JAX_PLATFORMS"] = "cpu"
     out = subprocess.run(
         [sys.executable, "bench.py", "--rows", "20000", "--iterations", "8",
@@ -92,9 +105,10 @@ def test_backend_probe_timeout_returns_none(monkeypatch):
     assert len(calls) == 2  # two attempts before giving up
 
 
-def test_bench_cpu_fallback_caps_workload(monkeypatch, capsys, tmp_path):
-    """When the backend probe degrades to CPU, the workload must shrink so
-    a marked number lands within driver patience."""
+def test_bench_probe_failure_reexecs_cpu(monkeypatch, tmp_path, capsys):
+    """A failed backend probe must RE-EXEC into a scrubbed CPU interpreter
+    (round 4: in-process env flips can't un-register a pre-imported axon
+    platform) and carry the _cpu_fallback marker via the environment."""
     monkeypatch.chdir(tmp_path)
     sys.path.insert(0, REPO)
     try:
@@ -103,15 +117,107 @@ def test_bench_cpu_fallback_caps_workload(monkeypatch, capsys, tmp_path):
         sys.path.remove(REPO)
     captured = {}
 
-    def fake_run(args, suffix, final):
-        # emulate _run_configs's entry: apply the fallback cap logic only
-        captured["suffix"] = suffix
-        raise SystemExit("stop before training")
+    def fake_execve(exe, argv, env):
+        captured["argv"] = argv
+        captured["env"] = env
+        raise SystemExit("execve reached")
 
     monkeypatch.setattr(bench, "_probe_backend", lambda **kw: None)
+    monkeypatch.setattr(bench.os, "execve", fake_execve)
+    monkeypatch.setattr(sys, "argv", ["bench.py", "--rows", "5000"])
+    bench.main()  # the stub's SystemExit is swallowed; the line still prints
+    rec = json.loads(capsys.readouterr().out.strip())
+    assert rec["metric"] == "train_time_failed"
+    assert "--no_probe" in captured["argv"]
+    assert "--rows" in captured["argv"]  # original args forwarded
+    assert captured["env"]["JAX_PLATFORMS"] == "cpu"
+    assert captured["env"]["XGBTPU_BENCH_CPU_FALLBACK"] == "1"
+    assert "PALLAS_AXON_POOL_IPS" not in captured["env"]
+    # the absolute deadline must survive the re-exec so the child doesn't
+    # restart the budget
+    assert "XGBTPU_BENCH_DEADLINE_AT" in captured["env"]
+
+
+def test_bench_probe_runs_with_jax_preimported(monkeypatch, tmp_path):
+    """Round-4 regression: the probe was guarded by `"jax" not in
+    sys.modules`, which is ALWAYS false under the axon sitecustomize, so
+    the whole robustness ladder was dead code in the bench environment.
+    The probe must run regardless of the parent's import state."""
+    monkeypatch.chdir(tmp_path)
+    sys.path.insert(0, REPO)
+    try:
+        import bench
+    finally:
+        sys.path.remove(REPO)
+    assert "jax" in sys.modules or __import__("jax")  # precondition: preimported
+    calls = []
+
+    def fake_probe(**kw):
+        calls.append(1)
+        return "cpu"
+
+    def fake_run(args, suffix, final):
+        raise SystemExit("stop before training")
+
+    monkeypatch.setattr(bench, "_probe_backend", fake_probe)
     monkeypatch.setattr(bench, "_run_configs", fake_run)
     monkeypatch.setattr(sys, "argv", ["bench.py"])
-    monkeypatch.setattr(sys, "modules", dict(sys.modules))
-    sys.modules.pop("jax", None)  # force the probe path
     bench.main()
-    assert captured["suffix"] == "_cpu_fallback"
+    assert calls, "probe must run even with jax already imported"
+
+
+def test_bench_watchdog_emits_on_midrun_hang():
+    """The round-4 driver failure mode: the process wedges inside a device
+    dispatch AFTER completing measurements, and nothing ever prints. The
+    watchdog must emit the best-completed (extrapolated) record and exit 0
+    while the main thread is still stuck."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("XGBTPU_BENCH_DEADLINE_AT", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XGBTPU_BENCH_TEST_HANG"] = "after_chunk"
+    env["XGBTPU_BENCH_DEADLINE"] = "150"
+    out = subprocess.run(
+        [sys.executable, "bench.py", "--rows", "4000", "--columns", "8",
+         "--iterations", "6", "--smoke_rows", "2000", "--budget", "120",
+         "--chunk", "2", "--tuned_max_bin", "0", "--no_probe"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=420,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [ln for ln in out.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, out.stdout
+    rec = json.loads(lines[0])
+    assert set(rec) == {"metric", "value", "unit", "vs_baseline"}
+    # one 2-round chunk of 6 completed before the hang -> extrapolated
+    assert "_extrapolated_from_2r" in rec["metric"], rec
+    assert rec["value"] > 0
+    assert "watchdog: deadline reached" in out.stderr
+
+
+def test_bench_hanging_jax_still_emits(tmp_path):
+    """The full round-4 scenario end-to-end: jax is importable but every
+    backend touch hangs forever (wedged relay). The probe must expire, the
+    CPU re-exec must happen, and when even THAT hangs (here: the fake jax
+    hangs on import in the child too) the watchdog must still land a
+    schema-valid JSON line with rc=0 — no configuration of hangs may
+    produce rc=124/parsed=null again."""
+    fake = tmp_path / "jax.py"
+    fake.write_text("import time\ntime.sleep(10_000)\n")
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("XGBTPU_BENCH_DEADLINE_AT", None)
+    env["PYTHONPATH"] = str(tmp_path) + os.pathsep + env.get("PYTHONPATH", "")
+    env["XGBTPU_BENCH_PROBE_TIMEOUT"] = "5"
+    env["XGBTPU_BENCH_DEADLINE"] = "30"
+    out = subprocess.run(
+        [sys.executable, "bench.py", "--rows", "4000"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [ln for ln in out.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, out.stdout
+    rec = json.loads(lines[0])
+    assert set(rec) == {"metric", "value", "unit", "vs_baseline"}
+    assert rec["metric"] == "train_time_failed"
+    # the probe expired (twice) and the re-exec path was taken
+    assert "re-exec with JAX_PLATFORMS=cpu" in out.stderr
