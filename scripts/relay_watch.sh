@@ -1,0 +1,31 @@
+#!/bin/bash
+# Patient relay watcher: one attach attempt at a time, long timeout,
+# backoff between attempts. Logs a HEALTHY line with memory stats when
+# the chip answers. Never two concurrent claims (round-4 lesson:
+# mid-claim kills wedge the pool).
+LOG=${1:-/tmp/relay_watch.log}
+while true; do
+  echo "[$(date +%H:%M:%S)] attempt" >> "$LOG"
+  timeout 900 python - >> "$LOG" 2>&1 <<'EOF'
+import time
+t0 = time.time()
+import jax
+d = jax.devices()[0]
+import jax.numpy as jnp
+x = jnp.ones((128, 128))
+float(x.sum())
+print(f"HEALTHY attach={time.time()-t0:.0f}s {d}", flush=True)
+try:
+    s = d.memory_stats()
+    print("MEMSTATS", {k: v for k, v in sorted(s.items())}, flush=True)
+except Exception as e:
+    print("memory_stats unavailable:", e, flush=True)
+EOF
+  rc=$?
+  if [ $rc -eq 0 ]; then
+    echo "[$(date +%H:%M:%S)] relay healthy, watcher exiting" >> "$LOG"
+    exit 0
+  fi
+  echo "[$(date +%H:%M:%S)] attach failed rc=$rc; backing off 300s" >> "$LOG"
+  sleep 300
+done

@@ -749,3 +749,42 @@ def test_dmatrix_surface_completions(tmp_path):
     d2 = xgb.DMatrix(fp)
     assert d2.num_row() == 120 and d2.feature_names == ["a", "b", "c", "dd"]
     np.testing.assert_allclose(d2.get_label(), y)
+
+
+def test_save_binary_exact_fname_and_full_metadata(tmp_path):
+    """The reference-canonical save_binary('train.buffer') must write
+    exactly that file (np.savez on a path appends '.npz' — ADVICE r4) and
+    persist weight/group/base_margin/feature_types, not just data+label."""
+    import os
+
+    X, y = _data(90, 3)
+    w = np.linspace(0.5, 1.5, 90).astype(np.float32)
+    bm = (y * 0.1).astype(np.float32)
+    d = xgb.DMatrix(X, label=y, weight=w, base_margin=bm,
+                    feature_names=["f0", "f1", "f2"],
+                    feature_types=["q", "q", "q"], group=[45, 45])
+    fp = str(tmp_path / "train.buffer")
+    d.save_binary(fp)
+    assert os.path.exists(fp), "save_binary must write exactly fname"
+    assert not os.path.exists(fp + ".npz")
+    d2 = xgb.DMatrix(fp)
+    np.testing.assert_allclose(d2.get_label(), y)
+    np.testing.assert_allclose(d2.get_weight(), w)
+    np.testing.assert_allclose(d2.get_base_margin(), bm)
+    np.testing.assert_array_equal(d2.get_group(), [45, 45])
+    assert d2.feature_names == ["f0", "f1", "f2"]
+    assert d2.feature_types == ["q", "q", "q"]
+    # training on the reloaded matrix sees identical data
+    b1 = xgb.train({"max_depth": 3, "seed": 0}, d, num_boost_round=3)
+    b2 = xgb.train({"max_depth": 3, "seed": 0}, d2, num_boost_round=3)
+    np.testing.assert_allclose(b1.predict(d), b2.predict(d2), rtol=1e-6)
+    # pathlib input takes the same full-metadata path as str
+    d3 = xgb.DMatrix(tmp_path / "train.buffer")
+    np.testing.assert_allclose(d3.get_weight(), w)
+    # unlabeled matrix round-trips to an unlabeled matrix (no empty-array
+    # label sneaking in)
+    d4 = xgb.DMatrix(X)
+    fp2 = str(tmp_path / "nolabel.buffer")
+    d4.save_binary(fp2)
+    d5 = xgb.DMatrix(fp2)
+    assert d5.info.label is None and d5.num_row() == 90

@@ -109,7 +109,7 @@ def test_hoisted_kernel_interpret_mode():
     pos = jnp.zeros((512, 1), jnp.int32)
     onehot = build_onehot(bins, B=16)
     ptab = jnp.zeros((1, 4), jnp.float32)
-    kern = functools.partial(hk._hoisted_kernel, K=1, Kp=0, F=4, B=16,
+    kern = functools.partial(hk._hoisted_kernel, K=1, Kp=0, F=4, Fh=4, B=16,
                              prev_offset=0, offset=0)
     pos_new, hist2 = pl.pallas_call(
         kern,
@@ -144,6 +144,105 @@ def test_hoist_budget_env(monkeypatch):
     assert hoist_budget_bytes() == 1024 * 1024
     # on CPU use_pallas() is False -> never hoist regardless of budget
     assert not can_hoist(1024, 4, 16)
+
+
+def test_hoist_plan_partial(monkeypatch):
+    """hoist_plan degrades to a feature PREFIX when the full expansion
+    outgrows the HBM budget (the 256-bin / small-free-HBM cases), and to 0
+    below the worthwhile minimum — never an OOM-destined full build."""
+    from xgboost_tpu.tree import hist_kernel as hk
+
+    monkeypatch.setattr(hk, "use_pallas", lambda: True)
+    n, F, B = 1 << 20, 50, 64
+    # generous budget: full hoist
+    monkeypatch.setenv("XGBTPU_HOIST_BUDGET_MB", str(8 * 1024))
+    assert hk.hoist_plan(n, F, B) == F
+    # 1 GiB: 16 features fit (2^20 * 64 B/feature = 64 MiB each)
+    monkeypatch.setenv("XGBTPU_HOIST_BUDGET_MB", "1024")
+    assert hk.hoist_plan(n, F, B) == 16
+    # below the minimum worthwhile prefix: no hoist
+    monkeypatch.setenv("XGBTPU_HOIST_BUDGET_MB", "128")
+    assert hk.hoist_plan(n, F, B) == 0
+    # bin256 with a full budget: HBM would allow 32 features but VMEM
+    # caps the streamed prefix — plan lands strictly between 0 and F
+    monkeypatch.setenv("XGBTPU_HOIST_BUDGET_MB", str(8 * 1024))
+    fh256 = hk.hoist_plan(n, F, 256)
+    assert 0 < fh256 < F
+    tr = hk._hoist_tr(fh256 * 256, 32, F, 256)
+    assert tr > 0, "plan must be streamable at the deepest level"
+
+
+def test_partial_hoist_kernel_interpret_mode():
+    """REAL kernel body with Fh < F (stream 2 features, construct 2) in
+    interpret mode against the segment-sum oracle — the partial-hoist
+    compute path end to end."""
+    import functools
+
+    from jax.experimental import pallas as pl
+
+    from xgboost_tpu.tree import hist_kernel as hk
+
+    bins, gh = _case(n=512, F=4, B=16, seed=11)
+    pos = jnp.zeros((512, 1), jnp.int32)
+    Fh = 2
+    onehot = build_onehot(bins[:, :Fh], B=16)  # [n, 32]
+    ptab = jnp.zeros((1, 4), jnp.float32)
+    kern = functools.partial(hk._hoisted_kernel, K=1, Kp=0, F=4, Fh=Fh,
+                             B=16, prev_offset=0, offset=0)
+    pos_new, hist2 = pl.pallas_call(
+        kern,
+        grid=(2,),
+        in_specs=[
+            pl.BlockSpec((256, 4), lambda c: (c, 0)),
+            pl.BlockSpec((256, 32), lambda c: (c, 0)),
+            pl.BlockSpec((256, 1), lambda c: (c, 0)),
+            pl.BlockSpec((256, 2), lambda c: (c, 0)),
+            pl.BlockSpec((1, 4), lambda c: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((256, 1), lambda c: (c, 0)),
+            pl.BlockSpec((2, 64), lambda c: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((512, 1), jnp.int32),
+            jax.ShapeDtypeStruct((2, 64), jnp.float32),
+        ],
+        interpret=True,
+    )(bins, onehot, pos, gh, ptab)
+    hist = jnp.transpose(hist2.reshape(2, 4, 16), (1, 0, 2))
+    _, want = fused_level_xla(bins, pos, gh, ptab, K=1, Kp=0, B=16, d=0)
+    np.testing.assert_allclose(np.asarray(hist), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_partial_hoist_end_to_end_interpret(monkeypatch):
+    """Full training through the public API with a forced PARTIAL hoist
+    (interpret-mode kernels) must produce the same model as the XLA path."""
+    import xgboost_tpu as xgb
+    from xgboost_tpu.tree import hist_kernel as hk
+
+    rng = np.random.RandomState(4)
+    X = rng.randn(600, 6).astype(np.float32)
+    y = (X @ rng.randn(6) > 0).astype(np.float32)
+    params = {"objective": "binary:logistic", "tree_method": "tpu_hist",
+              "max_depth": 3, "max_bin": 16, "eta": 0.3, "seed": 0}
+
+    dtrain = xgb.DMatrix(X, label=y)
+    bst_xla = xgb.train(params, dtrain, num_boost_round=3)
+    want = bst_xla.predict(xgb.DMatrix(X))
+
+    # force the pallas dispatch in interpret mode with a partial plan
+    monkeypatch.setattr(hk, "use_pallas", lambda: True)
+    monkeypatch.setattr(hk, "_INTERPRET", True)
+    monkeypatch.setattr(hk, "hoist_plan",
+                        lambda n_pad, F, B, max_depth=6: 4)  # 4 of 6
+    d2 = xgb.DMatrix(X, label=y)
+    binned = d2.get_binned(16, None)
+    oh = binned.fused_onehot(3)
+    assert oh is not None and oh.shape[1] == 4 * 16
+    bst_p = xgb.train(params, d2, num_boost_round=3)
+    got = bst_p.predict(xgb.DMatrix(X))
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
 
 
 def test_hoist_gates_agree():

@@ -78,9 +78,27 @@ def allreduce(data: np.ndarray, op: int = Op.SUM) -> np.ndarray:
 
 
 def broadcast(data, root: int):
-    """Reference collective.py:broadcast — with a single controller every
-    process already holds identical python values; returns ``data``."""
-    return data
+    """Reference collective.py:broadcast — ship ``root``'s value to every
+    process. Ranks can legitimately hold different values (a rank-0-loaded
+    model, a locally computed threshold), so this must actually move data:
+    allgather every process's pickled payload through the distributed
+    runtime and select the root's entry. Identity when single-process."""
+    if get_world_size() == 1:
+        return data
+    import pickle
+
+    from jax.experimental import multihost_utils
+
+    payload = np.frombuffer(pickle.dumps(data), dtype=np.uint8)
+    # Fixed-size buffer: allgather needs equal shapes across processes.
+    sizes = multihost_utils.process_allgather(
+        np.asarray([payload.size], np.int64))
+    cap = int(np.max(sizes))
+    buf = np.zeros(cap, np.uint8)
+    buf[: payload.size] = payload
+    gathered = np.asarray(multihost_utils.process_allgather(buf))  # [P,cap]
+    root_size = int(np.asarray(sizes).ravel()[root])
+    return pickle.loads(gathered[root, :root_size].tobytes())
 
 
 def communicator_print(msg: str) -> None:

@@ -137,12 +137,17 @@ def dispatch_data(
             else:
                 fmt = "libsvm"
         if fmt == "binary":
-            # DMatrix.save_binary round-trip (reference .buffer files)
+            # DMatrix.save_binary round-trip (reference .buffer files).
+            # DMatrix itself intercepts binary paths before dispatch_data
+            # (full MetaInfo restore); this branch only serves direct
+            # dispatch_data callers, so every key beyond data is optional.
             with np.load(path, allow_pickle=False) as z:
                 X = z["data"].astype(np.float32)
-                label = z["label"] if z["label"].size else None
+                label = (z["label"] if "label" in z.files and z["label"].size
+                         else None)
                 qid = None
-                names = [str(x) for x in z["feature_names"]]
+                names = ([str(x) for x in z["feature_names"]]
+                         if "feature_names" in z.files else [])
                 feature_names = names or None
         elif fmt == "csv":
             X, label = load_csv(path)

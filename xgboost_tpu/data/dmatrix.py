@@ -13,6 +13,7 @@ first use by the hist updater and cached — mirroring the reference where
 
 from __future__ import annotations
 
+import os
 from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
@@ -93,6 +94,16 @@ class DMatrix:
         nthread: Optional[int] = None,  # accepted for API compat; single-controller
     ) -> None:
         auto_names = auto_types = auto_label = auto_qid = None
+        self.info = MetaInfo()
+        if isinstance(data, (str, os.PathLike)) and self._looks_binary(
+                os.fspath(data)):
+            # save_binary round-trip: restores the full MetaInfo, not just
+            # data+label, so handle it before the generic adapter sweep
+            self._load_binary(data)
+            self._finish_init(label, weight, base_margin, feature_names,
+                              feature_types, group, qid, label_lower_bound,
+                              label_upper_bound, feature_weights)
+            return
         if hasattr(data, "tocsr") and hasattr(data, "nnz"):
             # scipy sparse stays sparse: no dense float materialization
             # (reference SparsePage storage, include/xgboost/data.h:260);
@@ -107,13 +118,27 @@ class DMatrix:
             )
             self._data: np.ndarray = X
             self._sparse = None
-        self.info = MetaInfo()
-        self.info.feature_names = list(feature_names) if feature_names else auto_names
-        self.info.feature_types = list(feature_types) if feature_types else auto_types
+        if auto_names and not feature_names:
+            self.info.feature_names = auto_names
+        if auto_types and not feature_types:
+            self.info.feature_types = auto_types
         if label is None and auto_label is not None:
             label = auto_label
         if qid is None and auto_qid is not None:
             qid = auto_qid
+        self._finish_init(label, weight, base_margin, feature_names,
+                          feature_types, group, qid, label_lower_bound,
+                          label_upper_bound, feature_weights)
+
+    def _finish_init(self, label, weight, base_margin, feature_names,
+                     feature_types, group, qid, label_lower_bound,
+                     label_upper_bound, feature_weights) -> None:
+        """Apply explicit constructor metadata (wins over anything the
+        adapter or a binary container supplied) and set up lazy caches."""
+        if feature_names:
+            self.info.feature_names = list(feature_names)
+        if feature_types:
+            self.info.feature_types = list(feature_types)
         if label is not None:
             self.set_label(label)
         if weight is not None:
@@ -216,16 +241,49 @@ class DMatrix:
 
     def save_binary(self, fname, silent: bool = True) -> None:
         """Persist data + metadata for fast reload via ``DMatrix(fname)``
-        (the reference's .buffer files; here an npz container)."""
-        label = self.info.label
-        np.savez(
-            fname,
-            data=np.asarray(self.data, np.float32),
-            label=(np.asarray(label, np.float32) if label is not None
-                   else np.array([], np.float32)),
-            feature_names=np.asarray(
-                [str(n) for n in (self.feature_names or [])]),
-        )
+        (the reference's .buffer files; here an npz container). Written
+        through an open handle so the file is exactly ``fname`` — np.savez
+        on a *path* appends '.npz', which would break the reference-
+        canonical ``save_binary('train.buffer')`` round-trip."""
+        fields = {"data": np.asarray(self.data, np.float32)}
+        for name in ("label", "weight", "base_margin", "group_ptr",
+                     "label_lower_bound", "label_upper_bound",
+                     "feature_weights"):
+            v = getattr(self.info, name)
+            if v is not None:
+                fields[name] = np.asarray(v)
+        fields["feature_names"] = np.asarray(
+            [str(n) for n in (self.feature_names or [])])
+        fields["feature_types"] = np.asarray(
+            [str(t) for t in (self.info.feature_types or [])])
+        with open(fname, "wb") as fh:
+            np.savez(fh, **fields)
+
+    @staticmethod
+    def _looks_binary(uri: str) -> bool:
+        path, _, fmt = uri.partition("?format=")
+        return fmt == "binary" or path.endswith((".buffer", ".npz"))
+
+    def _load_binary(self, uri: str) -> None:
+        """Restore a save_binary container: data plus every persisted
+        MetaInfo field (reference: SimpleDMatrix binary load,
+        simple_dmatrix.cc SaveToLocalFile/LoadBinary round-trip)."""
+        path = os.fspath(uri).partition("?format=")[0]
+        with np.load(path, allow_pickle=False) as z:
+            self._data = z["data"].astype(np.float32)
+            self._sparse = None
+            for name in ("label", "weight", "base_margin", "group_ptr",
+                         "label_lower_bound", "label_upper_bound",
+                         "feature_weights"):
+                # legacy containers wrote empty arrays as the "unset"
+                # sentinel — keep those None, not set-but-empty
+                if name in z.files and z[name].size:
+                    setattr(self.info, name, np.asarray(z[name]))
+            names = [str(x) for x in z["feature_names"]]
+            self.info.feature_names = names or None
+            if "feature_types" in z.files:
+                types = [str(x) for x in z["feature_types"]]
+                self.info.feature_types = types or None
 
     def set_label(self, label: Any) -> None:
         self.info.label = np.asarray(label, dtype=np.float32).reshape(-1)

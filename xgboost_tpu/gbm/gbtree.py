@@ -739,10 +739,16 @@ def _stack_device_alloc_mixed(entries: List[Any], tree_info,
     right = jnp.where(keep, field2d("right", -1, jnp.int32), -1)
     cond = jnp.where(keep, field2d("split_cond", 0.0, jnp.float32),
                      field2d("leaf_value", 0.0, jnp.float32))
-    md = 1 + int(max(
-        int(jnp.max(e.chunk.fields["depth"])) if isinstance(e, _AllocChunkRef)
-        else int(jnp.max(e.depth))
-        for e in entries))
+    # Static depth bound — reading fields["depth"] here would force a
+    # device->host sync inside the no-sync catch-up path (ADVICE r4). When
+    # cfg max_depth is 0 (unbounded lossguide), a tree over M=2L-1 alloc
+    # slots has depth <= L-1 = (M-1)//2; an over-estimate only costs walk
+    # iterations, never correctness.
+    def depth_bound(e):
+        cap = e.chunk.max_depth if isinstance(e, _AllocChunkRef) else e.max_depth
+        return cap if cap and cap > 0 else (width(e) - 1) // 2
+
+    md = 1 + max(depth_bound(e) for e in entries)
     group = np.zeros(Tp, np.int32)
     group[:T] = np.asarray(tree_info, np.int32)
     return StackedForest(
@@ -1272,6 +1278,8 @@ class GBTree:
             from ..parallel.grow import distributed_grow_tree_fused
 
             binsf, n_pad = binned.fused_bins_mesh(mesh)
+            onehot_mesh = (None if cfg.has_categorical
+                           else binned.fused_onehot_mesh(mesh, tp.max_depth))
 
             def grow_one(g, h, key):
                 if n_pad != n:
@@ -1282,6 +1290,7 @@ class GBTree:
                 return distributed_grow_tree_fused(
                     mesh, binsf, g, h, cut_vals, key,
                     jnp.float32(tp.eta), jnp.float32(tp.gamma), cfg, fw,
+                    onehot=onehot_mesh,
                 )
         else:
             binsf, n_pad = binned.fused_bins()

@@ -367,9 +367,12 @@ def predict_margin(
             # so the perf cliff is observable.
             from ..utils import console_logger
 
+            # XlaRuntimeError/JaxRuntimeError also wrap TRANSIENT runtime
+            # failures (device busy, relay hiccup — the documented failure
+            # mode here), so type alone must not blacklist; those types are
+            # permanent only with a compile-layer substring (ADVICE r4).
             permanent = type(e).__name__ in (
-                "XlaRuntimeError", "JaxRuntimeError", "NotImplementedError",
-                "MosaicError", "InternalError", "ResourceExhaustedError",
+                "NotImplementedError", "MosaicError",
             ) or any(t in str(e).lower() for t in ("vmem", "mosaic"))
             if permanent:
                 key = (T, Np, forest.max_depth, X.shape[1], forest.n_groups)

@@ -42,6 +42,7 @@ import numpy as np
 __all__ = [
     "fused_level", "fused_level_xla", "partition_apply_xla", "leaf_delta",
     "TR", "use_pallas", "build_onehot", "hoist_budget_bytes", "can_hoist",
+    "hoist_plan", "device_free_bytes",
 ]
 
 TR = 1024  # rows per kernel grid step
@@ -80,30 +81,69 @@ def use_pallas() -> bool:
 
 _HOIST_BUDGET_ENV = "XGBTPU_HOIST_BUDGET_MB"
 
+# Below this many streamed features a partial hoist is not worth the
+# resident HBM: the construct loop dominates either way.
+_MIN_HOIST_FEATURES = 4
+
+
+def device_free_bytes() -> Optional[int]:
+    """Free HBM on the default device per the runtime's allocator stats,
+    or None when the platform doesn't report them. Measured (round 5): the
+    relay-attached v5e exposes far less than the nominal 16 GiB, so a
+    static budget OOMs — the budget must come from the chip."""
+    try:
+        s = jax.devices()[0].memory_stats()
+        return int(s["bytes_limit"]) - int(s["bytes_in_use"])
+    except Exception:
+        return None
+
 
 def hoist_budget_bytes() -> int:
-    """HBM budget for the resident one-hot (default 8 GiB on a 16 GiB v5e;
-    override with XGBTPU_HOIST_BUDGET_MB, 0 disables hoisting)."""
+    """HBM budget for the resident one-hot. XGBTPU_HOIST_BUDGET_MB wins
+    when set (0 disables hoisting); otherwise 8 GiB clamped to 60% of the
+    device's *measured* free HBM when the runtime reports it."""
     import os
 
-    try:
-        mb = int(os.environ.get(_HOIST_BUDGET_ENV, "8192"))
-    except ValueError:
-        mb = 8192
-    return mb * 1024 * 1024
+    env = os.environ.get(_HOIST_BUDGET_ENV)
+    if env is not None:
+        try:
+            return int(env) * 1024 * 1024
+        except ValueError:
+            pass
+    budget = 8192 * 1024 * 1024
+    free = device_free_bytes()
+    if free is not None:
+        budget = min(budget, int(free * 0.6))
+    return budget
+
+
+def hoist_plan(n_pad: int, F: int, B: int, max_depth: int = 6) -> int:
+    """How many (leading) features to keep HBM-resident as a one-hot:
+    the largest ``Fh <= F`` whose [n_pad, Fh*B] int8 expansion fits the
+    HBM budget AND whose streaming working set fits VMEM at every level of
+    the configured depth (``_hoist_tr`` — build and dispatch share one
+    model). ``Fh == F`` is the full hoist; ``0 < Fh < F`` streams the
+    first Fh features and constructs the rest in-kernel (the
+    feature-group partitioning idea of the reference's
+    gpu_hist/histogram.cu:127-177 applied to the resident expansion);
+    0 means construct everything."""
+    if not use_pallas() or B <= 0 or n_pad <= 0:
+        return 0
+    budget = hoist_budget_bytes()
+    fh = min(F, budget // (n_pad * B))
+    deepest_K = 1 << max(max_depth - 1, 0)
+    while fh > 0 and _hoist_tr(fh * B, deepest_K, F, B) == 0:
+        fh -= 1
+    # the "not worth the resident HBM" floor applies only to PARTIAL
+    # hoists — a full hoist of a narrow matrix (F < 4) is still a win
+    if fh < F and fh < _MIN_HOIST_FEATURES:
+        return 0
+    return int(fh)
 
 
 def can_hoist(n_pad: int, F: int, B: int, max_depth: int = 6) -> bool:
-    """Whether hoisting pays: the [n_pad, F*B] int8 one-hot fits the HBM
-    budget, the pallas path is live (the XLA fallback's segment_sum never
-    needs it), AND the streaming kernel's VMEM working set fits at EVERY
-    level of the configured depth (``_hoist_tr``, the same gate
-    ``fused_level`` applies) — otherwise a multi-GiB resident array would
-    be built that the dispatcher then never streams."""
-    if not (use_pallas() and n_pad * F * B <= hoist_budget_bytes()):
-        return False
-    deepest_K = 1 << max(max_depth - 1, 0)
-    return _hoist_tr(F * B, deepest_K, F) > 0
+    """Whether the FULL one-hot can be hoisted (see ``hoist_plan``)."""
+    return hoist_plan(n_pad, F, B, max_depth) == F
 
 
 @functools.partial(jax.jit, static_argnames=("B",))
@@ -275,11 +315,12 @@ def _fused_level_pallas(bins, pos, gh, ptab, *, K, Kp, B, d, tr=TR,
 
 
 def _hoisted_kernel(bins_ref, oh_ref, pos_ref, gh_ref, ptab_ref, pos_out,
-                    hist_ref, *, K: int, Kp: int, F: int, B: int,
+                    hist_ref, *, K: int, Kp: int, F: int, Fh: int, B: int,
                     prev_offset: int, offset: int):
-    """Hoisted-one-hot grid step: partition + grad channels (cheap VPU) and
-    ONE [4K, Tr] x [Tr, F*B] MXU matmul streaming the resident one-hot —
-    no in-kernel one-hot construction at all."""
+    """Hoisted-one-hot grid step: partition + grad channels (cheap VPU),
+    ONE [4K, Tr] x [Tr, Fh*B] MXU matmul streaming the resident one-hot
+    for the first ``Fh`` features, and an in-kernel construct loop for the
+    remaining ``F - Fh`` (empty when the full expansion fit HBM)."""
     from jax.experimental import pallas as pl
 
     c = pl.program_id(0)
@@ -290,18 +331,28 @@ def _hoisted_kernel(bins_ref, oh_ref, pos_ref, gh_ref, ptab_ref, pos_out,
 
     pos = pos_ref[:, :]
     binsb = bins_ref[:, :]
+    Tr = binsb.shape[0]
     if Kp > 0:
         pos = _partition_tile(pos, binsb, ptab_ref, Kp=Kp, F=F, B=B,
                               prev_offset=prev_offset)
     pos_out[:, :] = pos
 
     ghs4 = _grad_channels(pos, gh_ref, K=K, offset=offset)  # [Tr, 4K]
-    oh = oh_ref[:, :].astype(jnp.bfloat16)  # [Tr, F*B] int8 -> bf16
+    oh = oh_ref[:, :].astype(jnp.bfloat16)  # [Tr, Fh*B] int8 -> bf16
     out = jax.lax.dot_general(
         ghs4, oh, (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
-    )  # [4K, F*B]
-    hist_ref[:, :] += out[: 2 * K] + out[2 * K:]
+    )  # [4K, Fh*B]
+    hist_ref[:, : Fh * B] += out[: 2 * K] + out[2 * K:]
+    for f in range(Fh, F):
+        col = binsb[:, f:f + 1]
+        iota_b = jax.lax.broadcasted_iota(jnp.int32, (Tr, B), 1)
+        ohf = (col == iota_b).astype(jnp.bfloat16)
+        outf = jax.lax.dot_general(
+            ghs4, ohf, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [4K, B]
+        hist_ref[:, f * B:(f + 1) * B] += outf[: 2 * K] + outf[2 * K:]
 
 
 @functools.partial(jax.jit,
@@ -313,13 +364,16 @@ def _hoisted_level_pallas(bins, onehot, pos, gh, ptab, *, K, Kp, B, d,
 
     n, F = bins.shape
     Q = F * B
-    assert onehot.shape == (n, Q), (onehot.shape, (n, Q))
+    Qh = onehot.shape[1]
+    Fh = Qh // B  # the onehot's width IS the partial-hoist plan
+    assert onehot.shape == (n, Qh) and Qh == Fh * B and Fh <= F, (
+        onehot.shape, F, B)
     assert n % tr == 0, f"rows {n} not padded to {tr}"
     prev_offset = (1 << (d - 1)) - 1 if d > 0 else 0
     offset = (1 << d) - 1
     W = ptab.shape[1]
     kern = functools.partial(
-        _hoisted_kernel, K=K, Kp=Kp, F=F, B=B,
+        _hoisted_kernel, K=K, Kp=Kp, F=F, Fh=Fh, B=B,
         prev_offset=prev_offset, offset=offset,
     )
     pos_new, hist2 = pl.pallas_call(
@@ -327,7 +381,7 @@ def _hoisted_level_pallas(bins, onehot, pos, gh, ptab, *, K, Kp, B, d,
         grid=(n // tr,),
         in_specs=[
             pl.BlockSpec((tr, F), lambda c: (c, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((tr, Q), lambda c: (c, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((tr, Qh), lambda c: (c, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((tr, 1), lambda c: (c, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((tr, 2), lambda c: (c, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((max(Kp, 1), W), lambda c: (0, 0),
@@ -407,20 +461,28 @@ _VMEM_ACC_BUDGET = 6 * 1024 * 1024  # bytes for the [F, 2K, B] accumulator
 _VMEM_HOIST_BUDGET = 12 * 1024 * 1024  # total working set of the hoisted step
 
 
-def _hoist_vmem_bytes(tr: int, Q: int, K: int, F: int) -> int:
+def _hoist_vmem_bytes(tr: int, Qh: int, K: int, F: int,
+                      B: Optional[int] = None) -> int:
     """Working-set estimate for one hoisted grid step: double-buffered int8
-    one-hot tile + its bf16 cast + the [4K, Q] dot output + the [2K, Q] f32
-    accumulator + the bins tile."""
-    return 2 * tr * Q + 2 * tr * Q + 4 * K * Q * 4 + 2 * K * Q * 4 + tr * F * 4
+    one-hot tile + its bf16 cast + the [4K, Qh] dot output + the [2K, F*B]
+    f32 accumulator (always full-width — the construct loop for unhoisted
+    features writes into it) + the bins tile + per-feature construct
+    scratch. ``B=None`` (legacy 3-arg callers) means full hoist: Qh==F*B."""
+    if B is None:
+        B = Qh // F
+    Q = F * B
+    construct = (tr * B * 2 + 4 * K * B * 4) if Qh < Q else 0
+    return (2 * tr * Qh + 2 * tr * Qh + 4 * K * Qh * 4
+            + 2 * K * Q * 4 + tr * F * 4 + construct)
 
 
-def _hoist_tr(Q: int, K: int, F: int) -> int:
+def _hoist_tr(Qh: int, K: int, F: int, B: Optional[int] = None) -> int:
     """Largest workable row tile for the hoisted kernel at this level's
     node count, or 0 if no tile fits VMEM. Single source of truth for both
-    the build-side gate (``can_hoist``) and the dispatch (``fused_level``)
+    the build-side gate (``hoist_plan``) and the dispatch (``fused_level``)
     so they cannot disagree."""
-    for tr in (TR_HOIST, TR_HOIST // 2):
-        if _hoist_vmem_bytes(tr, Q, K, F) <= _VMEM_HOIST_BUDGET:
+    for tr in (TR_HOIST, TR_HOIST // 2, TR_HOIST // 4):
+        if _hoist_vmem_bytes(tr, Qh, K, F, B) <= _VMEM_HOIST_BUDGET:
             return tr
     return 0
 
@@ -442,7 +504,7 @@ def fused_level(bins, pos, gh, ptab, *, K, Kp, B, d, pallas: bool,
         # varying, so relax it — a no-op on device
         ptab = jax.lax.pcast(ptab, (axis_name,), to="varying")
     if pallas and onehot is not None:
-        tr = _hoist_tr(F * B, K, F)
+        tr = _hoist_tr(onehot.shape[1], K, F, B)
         if tr and bins.shape[0] % tr == 0:
             return _hoisted_level_pallas(bins, onehot, pos, gh, ptab,
                                          K=K, Kp=Kp, B=B, d=d, tr=tr,
