@@ -1,18 +1,25 @@
 """TPU microbench: hoisted one-hot kernel vs in-kernel construction.
 
 Measures (single v5e chip, headline 1M x 50 shapes):
+- the chip's real free HBM (memory_stats) — the hoist budget source;
 - per-level times for the construct kernel vs the hoisted streaming
-  kernel at bin64/bin128, plus bin256 construct (docs/perf.md table);
+  kernel at bin64, partial hoist at bin256 (docs/perf.md table);
 - whole-chunk update_many throughput at bin64 with a first-vs-last-chunks
   decay check (VERDICT r3 weak #4);
 - shard_map + Mosaic on a 1-device mesh (the distributed kernel path).
 
 Run ALONE on the TPU (single attached process, never killed mid-run).
+Every section is independently fault-isolated: an OOM or Mosaic reject
+logs and moves on rather than killing the process (round-5 lesson: the
+first run died at build_onehot — the relay chip exposes far less free
+HBM than a nominal v5e — and the crash wedged the relay for an hour).
 All timings force a value readback (block_until_ready does not round-trip
 the axon relay). Results feed docs/perf.md.
 """
+import os
 import sys
 import time
+import traceback
 
 import numpy as np
 
@@ -27,12 +34,11 @@ import jax.numpy as jnp
 
 log(f"backend: {jax.default_backend()} devices: {jax.devices()}")
 
-import os
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
 jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
 
 from xgboost_tpu.tree.hist_kernel import (
-    build_onehot, fused_level, _hoist_tr, TR,
+    build_onehot, device_free_bytes, fused_level, hoist_plan, _hoist_tr, TR,
 )
 
 N = 1_000_000
@@ -44,8 +50,31 @@ def drain(x):
     return float(np.asarray(x).ravel()[:1].sum())
 
 
+def section(name):
+    """Decorator: run a section, catch + log everything."""
+    def deco(fn):
+        log(f"=== {name} ===")
+        try:
+            fn()
+        except Exception as e:
+            traceback.print_exc()
+            log(f"SECTION FAILED ({name}): {type(e).__name__}: {e}")
+    return deco
+
+
+@section("device memory")
+def _mem():
+    free = device_free_bytes()
+    log(f"device_free_bytes: "
+        f"{'unavailable' if free is None else f'{free/1e9:.2f} GB'}")
+    try:
+        s = jax.devices()[0].memory_stats()
+        log(f"memory_stats: { {k: v for k, v in sorted(s.items())} }")
+    except Exception as e:
+        log(f"memory_stats unavailable: {e}")
+
+
 def time_loop(fn, reps, drain_out):
-    # warmup + compile
     out = fn()
     drain(drain_out(out))
     t0 = time.perf_counter()
@@ -55,12 +84,12 @@ def time_loop(fn, reps, drain_out):
     return (time.perf_counter() - t0) / reps
 
 
-def level_bench(B, d, K, Kp, hoisted, reps=20):
+def level_bench(B, d, K, Kp, fh, reps=20):
+    """One level's time; fh = hoisted feature count (0 = construct)."""
     n_pad = -(-N // TR) * TR
     bins = rng.randint(0, B, size=(n_pad, F)).astype(np.int32)
     bins_j = jnp.asarray(bins)
     gh = jnp.asarray(rng.randn(n_pad, 2).astype(np.float32))
-    offset = (1 << d) - 1
     prev_off = (1 << (d - 1)) - 1 if d > 0 else 0
     pos = jnp.asarray(rng.randint(prev_off, prev_off + max(Kp, 1),
                                   size=(n_pad, 1)).astype(np.int32))
@@ -70,75 +99,101 @@ def level_bench(B, d, K, Kp, hoisted, reps=20):
                   rng.randint(0, B, max(Kp, 1)).astype(np.float32),
                   np.ones(max(Kp, 1), np.float32)], axis=1))
     onehot = None
-    if hoisted:
+    if fh:
         t0 = time.perf_counter()
-        onehot = build_onehot(bins_j, B=B)
+        onehot = build_onehot(bins_j[:, :fh], B=B)
         drain(onehot[:1, :1])
-        log(f"  build_onehot B={B}: {time.perf_counter()-t0:.2f}s "
-            f"({n_pad*F*B/1e9:.1f} GB)")
+        log(f"  build_onehot B={B} fh={fh}: {time.perf_counter()-t0:.2f}s "
+            f"({n_pad*fh*B/1e9:.1f} GB)")
 
     def run():
         return fused_level(bins_j, pos, gh, ptab, K=K, Kp=Kp, B=B, d=d,
                            pallas=True, onehot=onehot)
 
     dt = time_loop(run, reps, lambda o: o[1])
-    tag = "hoisted" if hoisted else "construct"
+    tag = f"hoisted fh={fh}" if fh else "construct"
     log(f"  level d={d} K={K} B={B} {tag}: {dt*1e3:.2f} ms")
     del onehot
     return dt
 
 
-log("=== per-level microbench, 1M x 50 ===")
-for B in (64, 128):
-    tr = _hoist_tr(F * B, 32, F)
-    log(f"B={B}: hoist tile tr={tr}")
-    level_bench(B, d=5, K=32, Kp=16, hoisted=False)
-    level_bench(B, d=5, K=32, Kp=16, hoisted=True)
-    level_bench(B, d=0, K=1, Kp=0, hoisted=True)
-log("B=256 construct (reference-default path):")
-level_bench(256, d=5, K=32, Kp=16, hoisted=False, reps=10)
+@section("per-level microbench, 1M x 50, bin64")
+def _levels64():
+    B = 64
+    n_pad = -(-N // TR) * TR
+    level_bench(B, d=5, K=32, Kp=16, fh=0)
+    fh = hoist_plan(n_pad, F, B, 6)
+    log(f"hoist_plan(bin64) -> fh={fh}")
+    if fh:
+        level_bench(B, d=5, K=32, Kp=16, fh=fh)
+        level_bench(B, d=0, K=1, Kp=0, fh=fh)
 
-log("=== whole-tree + chunk throughput, bin64 ===")
-import xgboost_tpu as xgb
 
-X = rng.randn(N, F).astype(np.float32)
-w = rng.randn(F).astype(np.float32)
-y = ((X @ w) * 0.5 + rng.randn(N) > 0).astype(np.float32)
-dtrain = xgb.DMatrix(X, label=y)
-params = {"objective": "binary:logistic", "tree_method": "tpu_hist",
-          "max_depth": 6, "max_bin": 64, "eta": 0.1}
-t0 = time.perf_counter()
-bst = xgb.Booster(params, [dtrain])
-bst.update_many(dtrain, 0, 25, chunk=25)
-entry = bst._caches.get(id(dtrain))
-drain(entry.margin[:1, :1])
-log(f"warmup chunk (bin+compile+25r): {time.perf_counter()-t0:.1f}s")
+@section("per-level microbench, bin256 (reference-default path)")
+def _levels256():
+    B = 256
+    n_pad = -(-N // TR) * TR
+    level_bench(B, d=5, K=32, Kp=16, fh=0, reps=10)
+    fh = hoist_plan(n_pad, F, B, 6)
+    log(f"hoist_plan(bin256) -> fh={fh}")
+    if fh:
+        level_bench(B, d=5, K=32, Kp=16, fh=fh, reps=10)
 
-times = []
-for c in range(1, 20):
+
+@section("whole-tree + chunk throughput, bin64")
+def _chunks():
+    import xgboost_tpu as xgb
+
+    X = rng.randn(N, F).astype(np.float32)
+    w = rng.randn(F).astype(np.float32)
+    y = ((X @ w) * 0.5 + rng.randn(N) > 0).astype(np.float32)
+    dtrain = xgb.DMatrix(X, label=y)
+    params = {"objective": "binary:logistic", "tree_method": "tpu_hist",
+              "max_depth": 6, "max_bin": 64, "eta": 0.1}
     t0 = time.perf_counter()
-    bst.update_many(dtrain, c * 25, 25, chunk=25)
+    bst = xgb.Booster(params, [dtrain])
+    bst.update_many(dtrain, 0, 25, chunk=25)
     entry = bst._caches.get(id(dtrain))
     drain(entry.margin[:1, :1])
-    dt = time.perf_counter() - t0
-    times.append(dt)
-    log(f"chunk {c}: 25 rounds in {dt:.2f}s ({25/dt:.1f} r/s)")
-log(f"chunks 1-5 mean: {np.mean(times[:5]):.2f}s; "
-    f"chunks 15-19 mean: {np.mean(times[-5:]):.2f}s "
-    f"(decay check: within 5%? "
-    f"{abs(np.mean(times[-5:])-np.mean(times[:5]))/np.mean(times[:5])*100:.1f}%)")
-proj = np.mean(times) * 20
-log(f"projected 500r at bin64: {proj:.1f}s (vs_baseline {36.01/proj:.2f})")
+    log(f"warmup chunk (bin+compile+25r): {time.perf_counter()-t0:.1f}s")
 
-log("=== 1-device mesh: shard_map + Mosaic validation ===")
-try:
+    times = []
+    for c in range(1, 20):
+        t0 = time.perf_counter()
+        bst.update_many(dtrain, c * 25, 25, chunk=25)
+        entry = bst._caches.get(id(dtrain))
+        drain(entry.margin[:1, :1])
+        dt = time.perf_counter() - t0
+        times.append(dt)
+        log(f"chunk {c}: 25 rounds in {dt:.2f}s ({25/dt:.1f} r/s)")
+    log(f"chunks 1-5 mean: {np.mean(times[:5]):.2f}s; "
+        f"chunks 15-19 mean: {np.mean(times[-5:]):.2f}s "
+        f"(decay check: within 5%? "
+        f"{abs(np.mean(times[-5:])-np.mean(times[:5]))/np.mean(times[:5])*100:.1f}%)")
+    proj = np.mean(times) * 20
+    log(f"projected 500r at bin64: {proj:.1f}s (vs_baseline {36.01/proj:.2f})")
+
+
+@section("1-device mesh: shard_map + Mosaic validation")
+def _mesh():
+    import xgboost_tpu as xgb
     from xgboost_tpu.parallel.grow import distributed_grow_tree_fused
     from xgboost_tpu.parallel.mesh import make_mesh
 
+    n_small = 1 << 18  # modest rows: validate Mosaic-under-shard_map only
+    X = rng.randn(n_small, F).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    dtrain = xgb.DMatrix(X, label=y)
+    params = {"objective": "binary:logistic", "tree_method": "tpu_hist",
+              "max_depth": 6, "max_bin": 64, "eta": 0.1}
+    bst = xgb.Booster(params, [dtrain])
+    bst._configure()  # _gbm is created lazily
     mesh1 = make_mesh(1)
     cfg = bst._gbm._grow_params()
     binned2 = dtrain.get_binned(64, None)
     binsf, n_pad2 = binned2.fused_bins_mesh(mesh1)
+    onehot = binned2.fused_onehot_mesh(mesh1, 6)
+    log(f"mesh onehot: {None if onehot is None else onehot.shape}")
     g = jnp.asarray(rng.randn(n_pad2).astype(np.float32))
     h = jnp.abs(jnp.asarray(rng.randn(n_pad2).astype(np.float32)))
     cut_vals = jnp.asarray(binned2.cuts.values)
@@ -146,7 +201,7 @@ try:
     t0 = time.perf_counter()
     tree = distributed_grow_tree_fused(
         mesh1, binsf, g, h, cut_vals, key,
-        jnp.float32(0.1), jnp.float32(0.0), cfg)
+        jnp.float32(0.1), jnp.float32(0.0), cfg, onehot=onehot)
     drain(tree.leaf_value[:1])
     log(f"mesh(1) shard_map + Mosaic kernel: OK "
         f"(compile+1 tree {time.perf_counter()-t0:.1f}s)")
@@ -154,12 +209,9 @@ try:
     for _ in range(10):
         tree = distributed_grow_tree_fused(
             mesh1, binsf, g, h, cut_vals, key,
-            jnp.float32(0.1), jnp.float32(0.0), cfg)
+            jnp.float32(0.1), jnp.float32(0.0), cfg, onehot=onehot)
     drain(tree.leaf_value[:1])
     log(f"mesh(1) tree: {(time.perf_counter()-t0)/10*1e3:.1f} ms")
-except Exception as e:
-    import traceback
-    traceback.print_exc()
-    log(f"mesh pallas FAILED: {type(e).__name__}: {e}")
+
 
 log("done")

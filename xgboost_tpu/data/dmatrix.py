@@ -279,8 +279,11 @@ class DMatrix:
                 # sentinel — keep those None, not set-but-empty
                 if name in z.files and z[name].size:
                     setattr(self.info, name, np.asarray(z[name]))
-            names = [str(x) for x in z["feature_names"]]
-            self.info.feature_names = names or None
+            # any key beyond data is optional: third-party npz files (a
+            # bare {"data": ...}) and legacy containers both load
+            if "feature_names" in z.files:
+                names = [str(x) for x in z["feature_names"]]
+                self.info.feature_names = names or None
             if "feature_types" in z.files:
                 types = [str(x) for x in z["feature_types"]]
                 self.info.feature_types = types or None
@@ -427,11 +430,15 @@ class DMatrix:
                 # all_gather (the quantile.cc:270 AllReduce site)
                 import jax.numpy as jnp
 
-                from ..parallel.mesh import pad_to_multiple, shard_rows
+                from ..parallel.mesh import (global_pad_rows,
+                                             local_device_count, shard_rows)
                 from ..parallel.sketch import distributed_compute_cuts
 
                 X = np.asarray(self.data, np.float32)
-                n_pad = pad_to_multiple(X.shape[0], mesh.devices.size)
+                # common per-process block (processes may hold ragged row
+                # slices); NaN pad rows are sketch-inert
+                n_pad = global_pad_rows(X.shape[0],
+                                        max(1, local_device_count(mesh)))
                 if n_pad != X.shape[0]:
                     X = np.concatenate(
                         [X, np.full((n_pad - X.shape[0], X.shape[1]), np.nan, np.float32)]

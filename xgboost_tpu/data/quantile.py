@@ -307,18 +307,17 @@ class BinnedMatrix:
         fh = hoist_plan(n_pad, self.n_features, B, max_depth)
         if fh == 0:
             return None
-        if self._onehot is None or self._onehot.shape[1] != fh * B:
-            from ..utils import console_logger
+        from ..utils import console_logger
 
-            gb = n_pad * fh * B / 1e9
-            part = ("" if fh == self.n_features
-                    else f" (partial: {fh}/{self.n_features} features"
-                         " stream, rest construct in-kernel)")
-            console_logger.info(
-                f"tpu_hist: hoisted one-hot active — {gb:.2f} GB "
-                f"HBM-resident ({n_pad}x{fh}x{B} int8){part}; "
-                "levels stream it through the MXU")
-            self._onehot = build_onehot(bins[:, :fh], B=B)
+        gb = n_pad * fh * B / 1e9
+        part = ("" if fh == self.n_features
+                else f" (partial: {fh}/{self.n_features} features"
+                     " stream, rest construct in-kernel)")
+        console_logger.info(
+            f"tpu_hist: hoisted one-hot active — {gb:.2f} GB "
+            f"HBM-resident ({n_pad}x{fh}x{B} int8){part}; "
+            "levels stream it through the MXU")
+        self._onehot = build_onehot(bins[:, :fh], B=B)
         return self._onehot
 
     def fused_onehot_mesh(self, mesh, max_depth: int = 6
@@ -330,15 +329,16 @@ class BinnedMatrix:
         device resides its own rows' expansion); the sharded build runs as
         a plain jit on the already-sharded bins, so XLA keeps the output
         row-sharded without a collective."""
-        from ..tree.hist_kernel import build_onehot, hoist_plan
+        from ..tree.hist_kernel import build_onehot, hoist_plan_synced
 
         if self._onehot_mesh is not None and self._onehot_mesh[0] == id(mesh):
             return self._onehot_mesh[1]
         binsf, n_pad = self.fused_bins_mesh(mesh)
         B = self.cuts.max_bin
-        # per-device rows: the global padded count over all mesh devices
+        # per-device rows: the global padded count over all mesh devices;
+        # plan agreed across processes (it shapes the SPMD program)
         shard_rows_n = binsf.shape[0] // mesh.devices.size
-        fh = hoist_plan(shard_rows_n, self.n_features, B, max_depth)
+        fh = hoist_plan_synced(shard_rows_n, self.n_features, B, max_depth)
         oh = build_onehot(binsf[:, :fh], B=B) if fh else None
         self._onehot_mesh = (id(mesh), oh)
         return oh
@@ -348,14 +348,16 @@ class BinnedMatrix:
         (all-missing, inert) to a multiple of tile x devices."""
         if self._fused_mesh is not None and self._fused_mesh[0] == id(mesh):
             return self._fused_mesh[1], self._fused_mesh[2]
-        from ..parallel.mesh import local_device_count, shard_rows
+        from ..parallel.mesh import (global_pad_rows, local_device_count,
+                                     shard_rows)
         from ..tree.grow_fused import TR
 
-        # pad THIS process's rows against its own device count: every
+        # pad THIS process's rows to the block size all processes agree on
+        # (max over processes of their own tile-padded count): every
         # process's local block is then the same fraction of the global
-        # array (multi-process: each process holds its own row slice)
+        # array even when load_row_split handed out ragged slices
         unit = TR * local_device_count(mesh)
-        n_pad = -(-self.n_rows // unit) * unit
+        n_pad = global_pad_rows(self.n_rows, unit)
         shards = shard_rows(self._pad_narrow(n_pad), mesh)
         self._fused_mesh = (id(mesh), shards, n_pad)
         return shards, n_pad

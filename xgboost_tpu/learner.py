@@ -162,6 +162,13 @@ class Booster:
     def update(self, dtrain: DMatrix, iteration: int, fobj=None) -> None:
         """One boosting iteration (reference UpdateOneIter learner.cc:1060)."""
         self._configure()
+        if fobj is None and jax.process_count() > 1:
+            # multi-process boosting only exists as scan chunks (per-round
+            # deltas stay device-sharded, gbtree.boost_one_round raises) —
+            # a single round IS a 1-chunk scan, so train()'s per-round
+            # loop with eval/early-stop composes with dsplit=row directly
+            self.update_many(dtrain, iteration, 1, chunk=1)
+            return
         fault.begin_version(iteration)
         fault.inject("gradient")
         if fobj is not None:
@@ -217,6 +224,12 @@ class Booster:
                                        dtrain.info.weight)
         if binned is None or not self._gbm.scan_rounds_supported(
                 binned, self._obj, self.n_groups):
+            if jax.process_count() > 1:
+                raise NotImplementedError(
+                    "this configuration is outside the multi-process scan "
+                    "envelope (ranking/survival/DART/lossguide/categorical/"
+                    "external-memory/custom objectives are single-process); "
+                    "see docs/distributed.md")
             for i in range(start_iteration, start_iteration + num_rounds):
                 self.update(dtrain, i)
             return
@@ -361,6 +374,7 @@ class Booster:
         n = dmat.num_row()
         paged = getattr(dmat, "_paged", None)
         if paged is not None:
+            self._warn_foreign_paged(dmat, paged)
             for k in range(paged.n_pages):
                 lo = k * paged.page_rows
                 yield lo, lo + paged.rows_of(k), jnp.asarray(
@@ -371,6 +385,50 @@ class Booster:
                 yield lo, hi, dmat._sparse.dense_rows(lo, hi)
         else:
             yield 0, n, dmat.data
+
+    def _warn_foreign_paged(self, dmat: DMatrix, paged) -> None:
+        """Page-streamed predict reconstructs features from cut MIDPOINTS,
+        which routes exactly only through split thresholds drawn from the
+        SAME cuts (data/external.py:midpoints). A foreign booster — loaded
+        from file or trained on other data — can flip decisions near
+        thresholds, so walking it over a paged matrix gets a loud warning
+        (reference cpu_predictor.cc:266 streams raw pages and has no such
+        approximation). Checked once per (matrix, model-size) pair: every
+        internal-node threshold must be a member of the matrix's own cut
+        set for its feature."""
+        if self._gbm.name not in ("gbtree", "dart"):
+            return
+        key = (id(dmat), self._gbm.model.num_trees)
+        if getattr(self, "_paged_cuts_checked", None) == key:
+            return
+        self._paged_cuts_checked = key
+        forest = self._gbm.model.stacked()
+        if forest.left.shape[0] == 0:
+            return
+        left = np.asarray(forest.left)
+        feat = np.asarray(forest.feature)
+        cond = np.asarray(forest.cond, np.float32)
+        internal = left >= 0
+        if not internal.any():
+            return
+        cuts = np.asarray(paged.cuts.values, np.float32)  # [F, B]
+        f = feat[internal].ravel()
+        c = cond[internal].ravel()
+        ok = np.zeros(f.shape[0], bool)
+        for fi in np.unique(f):
+            sel = f == fi
+            ok[sel] = np.isin(c[sel], cuts[int(fi)])
+        if not ok.all():
+            import warnings
+
+            warnings.warn(
+                "predict on an external-memory matrix with a booster whose "
+                f"split thresholds are not drawn from this matrix's cuts "
+                f"({int((~ok).sum())}/{ok.size} internal nodes foreign): "
+                "page-streamed features are reconstructed from cut "
+                "midpoints, so decisions near thresholds may flip. "
+                "Predict from an in-memory DMatrix for exact results.",
+                UserWarning, stacklevel=4)
 
     def _predict_margin(self, dmat: DMatrix, iteration_range=None) -> jax.Array:
         self._configure()

@@ -16,7 +16,17 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..registry import METRICS
-from .base import Metric
+from .base import Metric, dist_reduce
+
+
+def _dist_mean(local: float, local_w: float) -> float:
+    """Weighted mean of per-process values (the reference's distributed
+    AUC: each worker contributes (auc * w, w) to one Allreduce,
+    auc.cc:293). NaN-weight-0 locals drop out; identity single-process."""
+    if np.isnan(local):
+        local, local_w = 0.0, 0.0
+    s, w = dist_reduce(local * local_w, local_w)
+    return s / w if w > 0 else float("nan")
 
 
 @jax.jit
@@ -71,9 +81,9 @@ def _grouped_auc(score, label, weight, group_of, n_groups):
     valid = (Wp_g > 0) & (Wn_g > 0)
     auc_g = num_g / jnp.maximum(Wp_g * Wn_g, 1e-30)
     cnt = valid.sum()
-    return jnp.where(cnt > 0,
-                     jnp.where(valid, auc_g, 0.0).sum() / jnp.maximum(cnt, 1),
-                     jnp.nan)
+    # (sum over valid groups, valid count): the caller divides — and the
+    # distributed reduction must weight by VALID groups, not all groups
+    return jnp.where(valid, auc_g, 0.0).sum(), cnt
 
 
 @METRICS.register("auc")
@@ -95,7 +105,7 @@ class AUC(Metric):
             aucs = []
             for k in range(preds.shape[1]):
                 aucs.append(float(_binary_auc(preds[:, k], (label_j == k).astype(jnp.float32), w)))
-            return float(np.mean(aucs))
+            return _dist_mean(float(np.mean(aucs)), float(w.sum()))
         if preds.ndim == 2:
             preds = preds[:, 0]
         if group_ptr is not None and len(group_ptr) > 2:
@@ -104,9 +114,13 @@ class AUC(Metric):
             # device calls
             sizes = np.diff(np.asarray(group_ptr)).astype(np.int64)
             group_of = np.repeat(np.arange(len(sizes), dtype=np.int32), sizes)
-            return float(_grouped_auc(preds, (label_j > 0).astype(jnp.float32),
-                                      w, jnp.asarray(group_of), len(sizes)))
-        return float(_binary_auc(preds, label_j, w))
+            auc_sum, cnt = _grouped_auc(
+                preds, (label_j > 0).astype(jnp.float32), w,
+                jnp.asarray(group_of), len(sizes))
+            s, c = dist_reduce(float(auc_sum), float(cnt))
+            return s / c if c > 0 else float("nan")
+        return _dist_mean(float(_binary_auc(preds, label_j, w)),
+                          float(w.sum()))
 
 
 @METRICS.register("aucpr")

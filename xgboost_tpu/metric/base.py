@@ -15,6 +15,23 @@ import numpy as np
 from ..registry import METRICS
 
 
+def dist_reduce(s: float, w: float) -> Tuple[float, float]:
+    """Sum a metric's (residue, weight) pair over every PROCESS of a
+    multi-process run — the reference's rabit Allreduce in every metric's
+    GetFinal (elementwise_metric.cu:372, auc.cc dist path). Without this,
+    each rank finalizes on its local eval shard and early stopping
+    diverges across ranks. Identity single-process."""
+    import jax
+
+    if jax.process_count() == 1:
+        return s, w
+    from jax.experimental import multihost_utils
+
+    arr = np.asarray(multihost_utils.process_allgather(
+        np.asarray([s, w], np.float64)))
+    return float(arr[:, 0].sum()), float(arr[:, 1].sum())
+
+
 class Metric:
     name: str = ""
     # maximize=True metrics (auc, ndcg, map...) flip early-stopping direction
@@ -55,7 +72,7 @@ class ElementwiseMetric(Metric):
             s, tw = (l * w).sum(), w.sum()
         else:
             s, tw = l.sum(), jnp.float32(l.shape[0])
-        return self.finalize(float(s), float(tw))
+        return self.finalize(*dist_reduce(float(s), float(tw)))
 
 
 def create_metric(name: str) -> Metric:

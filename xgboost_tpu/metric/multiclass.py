@@ -6,7 +6,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from ..registry import METRICS
-from .base import Metric
+from .base import Metric, dist_reduce
 
 _EPS = 1e-16
 
@@ -23,8 +23,11 @@ class MultiError(Metric):
             yhat = jnp.argmax(preds, axis=-1)
         wrong = (yhat.astype(jnp.int32) != label.astype(jnp.int32)).astype(jnp.float32)
         if weight is not None and weight.size:
-            return float((wrong * weight).sum() / weight.sum())
-        return float(wrong.mean())
+            s, w = float((wrong * weight).sum()), float(weight.sum())
+        else:
+            s, w = float(wrong.sum()), float(wrong.shape[0])
+        s, w = dist_reduce(s, w)
+        return s / w if w else s
 
 
 @METRICS.register("mlogloss")
@@ -37,5 +40,8 @@ class MultiLogLoss(Metric):
         picked = jnp.take_along_axis(p, idx[:, None], axis=1)[:, 0]
         l = -jnp.log(jnp.clip(picked, _EPS, 1.0))
         if weight is not None and weight.size:
-            return float((l * weight).sum() / weight.sum())
-        return float(l.mean())
+            s, w = float((l * weight).sum()), float(weight.sum())
+        else:
+            s, w = float(l.sum()), float(l.shape[0])
+        s, w = dist_reduce(s, w)
+        return s / w if w else s

@@ -51,7 +51,12 @@ class _PerGroupMetric(Metric):
         k = self.topn if self.topn > 0 else int(sizes.max(initial=0))
         scores = self.group_scores(ys, group_of, local, sizes, k)
         scores = scores[sizes > 0]
-        return float(scores.mean()) if len(scores) else float("nan")
+        # distributed: sum-of-scores / total groups over all processes
+        # (rank_metric.cc GetFinal's rabit pattern)
+        from .base import dist_reduce
+
+        s, c = dist_reduce(float(scores.sum()), float(len(scores)))
+        return s / c if c > 0 else float("nan")
 
 
 @METRICS.register("ndcg@", "ndcg")

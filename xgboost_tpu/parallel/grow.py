@@ -215,19 +215,28 @@ def distributed_boost_rounds_scan(
             rep(feature_weights), rep(seed_base), rep(n_arr))
     else:
         n_arr = jnp.asarray([n], jnp.int32)
+    from ..tree.hist_kernel import hoist_plan_synced
+
+    # per-shard hoisted one-hot plan, decided OUTSIDE the jit and agreed
+    # across processes (min over ranks): it is baked statically into the
+    # traced SPMD program, and ranks can see different free HBM
+    D = mesh.devices.size
+    fh = (0 if cfg.has_categorical
+          else hoist_plan_synced(margin.shape[0] // D, bins.shape[1],
+                                 cut_values.shape[1], cfg.max_depth))
     return _dist_scan_impl(
         bins, label, weight, margin, iters, cut_values, eta, gamma,
         feature_weights, seed_base, n_arr, mesh=mesh, obj=obj,
         obj_fp=_obj_fingerprint(obj), cfg=cfg,
-        d_local=local_device_count(mesh),
+        d_local=local_device_count(mesh), fh=fh,
     )
 
 
 @partial(jax.jit, static_argnames=("mesh", "obj", "obj_fp", "cfg",
-                                   "d_local"))
+                                   "d_local", "fh"))
 def _dist_scan_impl(bins, label, weight, margin, iters, cut_values, eta,
                     gamma, feature_weights, seed_base, n_arr, *, mesh, obj,
-                    obj_fp, cfg, d_local):
+                    obj_fp, cfg, d_local, fh):
     import dataclasses
 
     import jax.numpy as jnp
@@ -235,19 +244,13 @@ def _dist_scan_impl(bins, label, weight, margin, iters, cut_values, eta,
 
     from ..gbm.gbtree import round_seed_traced
 
-    from ..tree.hist_kernel import build_onehot, hoist_plan
+    from ..tree.hist_kernel import build_onehot
 
     cfg_dist = dataclasses.replace(cfg, axis_name=ROW_AXIS)
     D = mesh.devices.size
     n_pad, K = margin.shape
     rows_local = n_pad // D
     B = cut_values.shape[1]
-    # per-shard hoisted one-hot (possibly partial: first fh features), built
-    # ONCE per chunk outside the scan body (loop-invariant): the
-    # distributed scan streams the same kernel the single-chip bench
-    # measures
-    fh = (0 if cfg.has_categorical
-          else hoist_plan(rows_local, bins.shape[1], B, cfg.max_depth))
 
     def shard_fn(bins_s, label_s, weight_s, m_s, fw, n_a):
         r = jax.lax.axis_index(ROW_AXIS)

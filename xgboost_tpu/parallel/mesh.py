@@ -86,6 +86,23 @@ def pad_to_multiple(n: int, k: int) -> int:
     return ((n + k - 1) // k) * k
 
 
+def global_pad_rows(n_local: int, unit: int) -> int:
+    """The COMMON per-process padded block size: ceil(n_local/unit)*unit,
+    maxed over all processes. Multi-process row sharding requires every
+    process to contribute equal padded blocks (shard_rows); real row
+    counts may be uneven (load_row_split hands ragged slices) — the
+    per-process validity masks (grow.py n_arr) make the extra padding
+    inert, so processes just agree on the largest block here."""
+    n_pad = pad_to_multiple(max(n_local, 1), unit)
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        sizes = np.asarray(multihost_utils.process_allgather(
+            np.asarray(n_pad, np.int64)))
+        n_pad = int(sizes.max())
+    return n_pad
+
+
 def local_device_count(mesh: Mesh) -> int:
     """Devices of ``mesh`` owned by THIS process (== mesh size when
     single-process). Row padding is computed per process against this, so

@@ -87,15 +87,33 @@ _MIN_HOIST_FEATURES = 4
 
 
 def device_free_bytes() -> Optional[int]:
-    """Free HBM on the default device per the runtime's allocator stats,
-    or None when the platform doesn't report them. Measured (round 5): the
-    relay-attached v5e exposes far less than the nominal 16 GiB, so a
-    static budget OOMs — the budget must come from the chip."""
+    """Free HBM on this process's OWN first device per the runtime's
+    allocator stats, or None when the platform doesn't report them.
+    Measured (round 5): the relay-attached v5e exposes far less than the
+    nominal 16 GiB, so a static budget OOMs — the budget must come from
+    the chip. local_devices (not devices) because on multi-process rank>0
+    ``jax.devices()[0]`` is a remote, non-addressable device."""
     try:
-        s = jax.devices()[0].memory_stats()
+        s = jax.local_devices()[0].memory_stats()
         return int(s["bytes_limit"]) - int(s["bytes_in_use"])
     except Exception:
         return None
+
+
+def hoist_plan_synced(n_pad: int, F: int, B: int, max_depth: int = 6) -> int:
+    """``hoist_plan`` agreed across processes (min over ranks): the plan is
+    baked statically into traced SPMD programs, so ranks with different
+    free HBM must not compile different programs."""
+    fh = hoist_plan(n_pad, F, B, max_depth)
+    if jax.process_count() > 1:
+        import numpy as _np
+
+        from jax.experimental import multihost_utils
+
+        all_fh = _np.asarray(multihost_utils.process_allgather(
+            _np.asarray(fh, _np.int64)))
+        fh = int(all_fh.min())
+    return fh
 
 
 def hoist_budget_bytes() -> int:
