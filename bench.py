@@ -194,6 +194,38 @@ def _probe_backend(timeout_s: float | None = None) -> str | None:
     return None
 
 
+def _release_device_memory() -> None:
+    """After a hard failure (OOM, backend error), drop EVERY device buffer
+    this process still references before retrying smaller: a failed
+    attempt's arrays otherwise stay live through lingering caches and keep
+    the allocator poisoned, turning one OOM into RESOURCE_EXHAUSTED at
+    every subsequent size (observed round 5: the first 1M-row OOM made
+    even 1953-row attempts fail). Everything the retry needs is rebuilt
+    from host data, so deleting all live arrays and clearing jit caches is
+    safe here (and ONLY here — mid-measurement state is still in use)."""
+    try:
+        import gc
+
+        import jax
+
+        gc.collect()
+        arrs = jax.live_arrays()
+        freed = 0
+        for a in arrs:
+            try:
+                a.delete()
+                freed += 1
+            except Exception:
+                pass
+        jax.clear_caches()
+        gc.collect()
+        print(f"# released {freed}/{len(arrs)} live device arrays + jit "
+              "caches after failure", file=sys.stderr, flush=True)
+    except Exception as e:
+        print(f"# device-memory release failed: {e}", file=sys.stderr,
+              flush=True)
+
+
 def _make_data(rows: int, cols: int, sparsity: float, seed: int = 42):
     rng = np.random.RandomState(seed)
     X = rng.randn(rows, cols).astype(np.float32)
@@ -377,6 +409,17 @@ def _run_configs(args, suffix: str, final: dict) -> None:
         set_final(rows, done, measured, "")
         _maybe_test_hang("after_chunk")
 
+    # On hard failure, FIRST step down the hoisted-one-hot HBM budget at
+    # unchanged scale (the relay chip does not report memory_stats, so the
+    # library's default budget can overshoot the real free HBM; a 1M-row
+    # number with a smaller / disabled hoist is worth far more than a
+    # quarter-scale number at full hoist) — only then halve rows. Budget 0
+    # (construct in-kernel, the round-3 measured configuration) is known
+    # to run the full 1M at both bin counts. An externally-set
+    # XGBTPU_HOIST_BUDGET_MB disables the ladder.
+    hoist_ladder = [None, "2048", "0"]
+    hoist_i = 0 if os.environ.get("XGBTPU_HOIST_BUDGET_MB") is None else \
+        len(hoist_ladder)
     while True:
         try:
             X, y = _make_data(rows, args.columns, args.sparsity)
@@ -390,6 +433,14 @@ def _run_configs(args, suffix: str, final: dict) -> None:
             # chunks completed before a HARD failure are not trustworthy
             # (unlike a clean budget stop): discard them from the record
             final.clear()
+            _release_device_memory()
+            if hoist_i + 1 < len(hoist_ladder):
+                hoist_i += 1
+                os.environ["XGBTPU_HOIST_BUDGET_MB"] = hoist_ladder[hoist_i]
+                print(f"# retrying {rows} rows with hoist budget "
+                      f"{hoist_ladder[hoist_i]} MB", file=sys.stderr,
+                      flush=True)
+                continue
             rows //= 2
             if rows < 1000:
                 raise SystemExit("benchmark failed at every size")

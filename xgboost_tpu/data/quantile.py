@@ -265,6 +265,10 @@ class BinnedMatrix:
     # mesh twin: row-sharded one-hot, keyed by mesh id — built once per
     # (fit, mesh), NOT once per tree (VERDICT r4 weak #5)
     _onehot_mesh: Optional[Tuple[int, Optional[jax.Array]]] = None
+    # frozen process-synced hoist plan, keyed by mesh id: ONE allgather
+    # per (fit, mesh), never per chunk — and immune to free-HBM drift
+    # flipping a jit static arg mid-fit
+    _hoist_plan_mesh: Optional[Tuple[int, int]] = None
 
     def fused_bins(self) -> Tuple[jax.Array, int]:
         """(bins padded to the kernel row tile, padded row count) for the
@@ -326,22 +330,49 @@ class BinnedMatrix:
         ONCE per (fit, mesh) and cached — the per-tree shard_map then
         streams it instead of reconstructing the expansion every tree
         (VERDICT r4 weak #5). The hoist plan is evaluated per SHARD (each
-        device resides its own rows' expansion); the sharded build runs as
-        a plain jit on the already-sharded bins, so XLA keeps the output
-        row-sharded without a collective."""
-        from ..tree.hist_kernel import build_onehot, hoist_plan_synced
+        device resides its own rows' expansion); the build itself runs
+        under ``shard_map`` — the Pallas tile build is an opaque custom
+        call GSPMD cannot partition, so a plain jit on the sharded bins
+        would gather/replicate the multi-GB expansion onto every device."""
+        from jax.sharding import PartitionSpec as P
+
+        from ..parallel.mesh import ROW_AXIS
+        from ..tree.hist_kernel import build_onehot
 
         if self._onehot_mesh is not None and self._onehot_mesh[0] == id(mesh):
             return self._onehot_mesh[1]
         binsf, n_pad = self.fused_bins_mesh(mesh)
         B = self.cuts.max_bin
-        # per-device rows: the global padded count over all mesh devices;
-        # plan agreed across processes (it shapes the SPMD program)
-        shard_rows_n = binsf.shape[0] // mesh.devices.size
-        fh = hoist_plan_synced(shard_rows_n, self.n_features, B, max_depth)
-        oh = build_onehot(binsf[:, :fh], B=B) if fh else None
+        fh = self.hoist_plan_mesh(mesh, max_depth)
+        if fh:
+            oh = jax.shard_map(
+                lambda b: build_onehot(b[:, :fh], B=B, vma=(ROW_AXIS,)),
+                mesh=mesh, in_specs=P(ROW_AXIS, None),
+                out_specs=P(ROW_AXIS, None))(binsf)
+        else:
+            oh = None
         self._onehot_mesh = (id(mesh), oh)
         return oh
+
+    def hoist_plan_mesh(self, mesh, max_depth: int = 6) -> int:
+        """The process-synced per-shard hoist plan for this (fit, mesh),
+        FROZEN at first evaluation: the plan is a jit static arg of the
+        SPMD programs, and ``hoist_plan`` reads live free HBM — replanning
+        per chunk would both re-allgather every round (train() routes
+        multi-process rounds as chunk=1 scans) and risk a mid-fit
+        recompile when free memory drifts across a feature boundary."""
+        from ..tree.hist_kernel import hoist_plan_synced
+
+        if (self._hoist_plan_mesh is not None
+                and self._hoist_plan_mesh[0] == id(mesh)):
+            return self._hoist_plan_mesh[1]
+        binsf, _ = self.fused_bins_mesh(mesh)
+        # per-device rows: the global padded count over all mesh devices
+        shard_rows_n = binsf.shape[0] // mesh.devices.size
+        fh = hoist_plan_synced(shard_rows_n, self.n_features,
+                               self.cuts.max_bin, max_depth)
+        self._hoist_plan_mesh = (id(mesh), fh)
+        return fh
 
     def fused_bins_mesh(self, mesh) -> Tuple[jax.Array, int]:
         """Row-sharded bins for the fused grower under a mesh: rows padded

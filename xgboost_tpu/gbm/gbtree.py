@@ -1424,6 +1424,8 @@ class GBTree:
                 shard_rows(weight_j, mesh) if weight_j is not None else None,
                 shard_rows(m_pad, mesh), iters, cut_vals, eta, gamma, fw,
                 jnp.uint32(seed_base), n, cfg,
+                onehot=binned.fused_onehot_mesh(mesh, tp.max_depth),
+                fh_plan=binned.hoist_plan_mesh(mesh, tp.max_depth),
             )
             from ..parallel.mesh import local_rows
 

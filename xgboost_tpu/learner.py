@@ -34,6 +34,17 @@ __all__ = ["Booster"]
 _VERSION = [2, 0, 0]  # this framework's model version triplet
 
 
+def _multiprocess_mesh_active() -> bool:
+    """True only when training would run the COLLECTIVE multi-process path:
+    several processes AND an active ``mesh_context``. A program that merely
+    initialized jax.distributed (e.g. for its own IO) but trains mesh-less
+    per-process boosters takes the normal local paths. Shares the metric
+    layer's predicate so routing and reductions cannot disagree."""
+    from .parallel.mesh import collective_active
+
+    return collective_active()
+
+
 class _PredCache:
     """Versioned prediction cache (reference: PredictionContainer,
     include/xgboost/predictor.h:242 — tracks how many trees are already
@@ -162,11 +173,13 @@ class Booster:
     def update(self, dtrain: DMatrix, iteration: int, fobj=None) -> None:
         """One boosting iteration (reference UpdateOneIter learner.cc:1060)."""
         self._configure()
-        if fobj is None and jax.process_count() > 1:
-            # multi-process boosting only exists as scan chunks (per-round
-            # deltas stay device-sharded, gbtree.boost_one_round raises) —
-            # a single round IS a 1-chunk scan, so train()'s per-round
-            # loop with eval/early-stop composes with dsplit=row directly
+        if fobj is None and _multiprocess_mesh_active():
+            # multi-process MESH boosting only exists as scan chunks
+            # (per-round deltas stay device-sharded, gbtree.boost_one_round
+            # raises) — a single round IS a 1-chunk scan, so train()'s
+            # per-round loop with eval/early-stop composes with dsplit=row
+            # directly. Multi-process WITHOUT an active mesh is per-process
+            # local training and takes the normal path.
             self.update_many(dtrain, iteration, 1, chunk=1)
             return
         fault.begin_version(iteration)
@@ -224,7 +237,7 @@ class Booster:
                                        dtrain.info.weight)
         if binned is None or not self._gbm.scan_rounds_supported(
                 binned, self._obj, self.n_groups):
-            if jax.process_count() > 1:
+            if _multiprocess_mesh_active():
                 raise NotImplementedError(
                     "this configuration is outside the multi-process scan "
                     "envelope (ranking/survival/DART/lossguide/categorical/"
@@ -417,6 +430,9 @@ class Booster:
         ok = np.zeros(f.shape[0], bool)
         for fi in np.unique(f):
             sel = f == fi
+            if not 0 <= int(fi) < cuts.shape[0]:
+                continue  # model splits on a feature the matrix lacks:
+                # definitely foreign, leave ok=False for these nodes
             ok[sel] = np.isin(c[sel], cuts[int(fi)])
         if not ok.all():
             import warnings

@@ -137,8 +137,22 @@ class AUCPR(Metric):
             if weight is not None and np.size(weight) == n
             else np.ones(n)
         )
+        local = self._local_aucpr(p, y, w)
+        # distributed: weighted mean of per-process local curves, invalid
+        # shards contributing (0, 0) — the reference's pair allreduce
+        # (auc.cc:115 Allreduce<Sum> over (auc * weight, weight))
+        if local != local:
+            s, c = dist_reduce(0.0, 0.0)
+        else:
+            s, c = dist_reduce(local * float(w.sum()), float(w.sum()))
+        return s / c if c > 0 else float("nan")
+
+    @staticmethod
+    def _local_aucpr(p, y, w) -> float:
         order = np.argsort(-p, kind="stable")
         y, w, p = y[order], w[order], p[order]
+        if len(y) == 0:
+            return float("nan")
         tp = np.cumsum(w * y)
         fp = np.cumsum(w * (1 - y))
         total_pos = tp[-1]

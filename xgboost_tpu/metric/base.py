@@ -17,13 +17,17 @@ from ..registry import METRICS
 
 def dist_reduce(s: float, w: float) -> Tuple[float, float]:
     """Sum a metric's (residue, weight) pair over every PROCESS of a
-    multi-process run — the reference's rabit Allreduce in every metric's
-    GetFinal (elementwise_metric.cu:372, auc.cc dist path). Without this,
-    each rank finalizes on its local eval shard and early stopping
-    diverges across ranks. Identity single-process."""
-    import jax
+    collective multi-process run — the reference's rabit Allreduce in
+    every metric's GetFinal (elementwise_metric.cu:372, auc.cc dist path).
+    Without this, each rank finalizes on its local eval shard and early
+    stopping diverges across ranks. Identity when training is local:
+    single process, OR multi-process without an active mesh (gated by
+    ``parallel.mesh.collective_active`` — the same predicate the learner's
+    routing uses — so a rank evaluating extra local models can never hang
+    the others in a surprise allgather)."""
+    from ..parallel.mesh import collective_active
 
-    if jax.process_count() == 1:
+    if not collective_active():
         return s, w
     from jax.experimental import multihost_utils
 

@@ -27,7 +27,7 @@ class MultiError(Metric):
         else:
             s, w = float(wrong.sum()), float(wrong.shape[0])
         s, w = dist_reduce(s, w)
-        return s / w if w else s
+        return s / w if w > 0 else float("nan")
 
 
 @METRICS.register("mlogloss")
@@ -44,4 +44,4 @@ class MultiLogLoss(Metric):
         else:
             s, w = float(l.sum()), float(l.shape[0])
         s, w = dist_reduce(s, w)
-        return s / w if w else s
+        return s / w if w > 0 else float("nan")

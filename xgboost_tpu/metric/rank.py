@@ -127,6 +127,13 @@ class AMS(Metric):
         self.name = full_name or f"ams@{arg}"
 
     def evaluate(self, preds, label, weight=None, **kw):
+        from ..parallel.mesh import collective_active
+
+        if collective_active():
+            # the global top-ratio cut cannot be formed from local sorts;
+            # the reference refuses too (rank_metric.cc:107)
+            raise ValueError(
+                "metric AMS does not support distributed evaluation")
         p = np.asarray(preds).reshape(-1)
         y = np.asarray(label)
         n = len(y)

@@ -170,6 +170,8 @@ def distributed_boost_rounds_scan(
     seed_base: jax.Array,  # uint32
     n: int,  # real (unpadded) global row count
     cfg: GrowParams,
+    onehot: Optional[jax.Array] = None,  # [n_pad, Fh*B] row-sharded, cached
+    fh_plan: Optional[int] = None,  # caller's frozen synced plan
 ):
     """A chunk of boosting rounds over row shards as ONE program: the
     ``lax.scan`` of (gradient -> fused tree -> margin update) runs inside a
@@ -215,18 +217,34 @@ def distributed_boost_rounds_scan(
             rep(feature_weights), rep(seed_base), rep(n_arr))
     else:
         n_arr = jnp.asarray([n], jnp.int32)
-    from ..tree.hist_kernel import hoist_plan_synced
+    if cfg.has_categorical:
+        onehot, fh = None, 0
+    elif onehot is not None:
+        # the caller's cached per-fit expansion (BinnedMatrix.
+        # fused_onehot_mesh): its width IS the (already process-synced)
+        # plan, and passing it as an operand means chunks — per ROUND
+        # under train()'s chunk=1 routing — never replan (a blocking
+        # allgather) or rebuild (multi-GB of HBM writes)
+        fh = onehot.shape[1] // cut_values.shape[1]
+    elif fh_plan is not None:
+        # the caller's frozen plan with no resident expansion (plan 0, or
+        # a standalone caller managing its own build): no per-chunk
+        # allgather, no free-HBM drift flipping this jit static arg
+        fh = fh_plan
+    else:
+        from ..tree.hist_kernel import hoist_plan_synced
 
-    # per-shard hoisted one-hot plan, decided OUTSIDE the jit and agreed
-    # across processes (min over ranks): it is baked statically into the
-    # traced SPMD program, and ranks can see different free HBM
-    D = mesh.devices.size
-    fh = (0 if cfg.has_categorical
-          else hoist_plan_synced(margin.shape[0] // D, bins.shape[1],
-                                 cut_values.shape[1], cfg.max_depth))
+        # no caller plan (direct/test callers): per-shard plan decided
+        # OUTSIDE the jit and agreed across processes (min over ranks) —
+        # it is baked statically into the traced SPMD program, and ranks
+        # can see different free HBM. The shard_fn then builds per
+        # dispatch.
+        D = mesh.devices.size
+        fh = hoist_plan_synced(margin.shape[0] // D, bins.shape[1],
+                               cut_values.shape[1], cfg.max_depth)
     return _dist_scan_impl(
         bins, label, weight, margin, iters, cut_values, eta, gamma,
-        feature_weights, seed_base, n_arr, mesh=mesh, obj=obj,
+        feature_weights, seed_base, n_arr, onehot, mesh=mesh, obj=obj,
         obj_fp=_obj_fingerprint(obj), cfg=cfg,
         d_local=local_device_count(mesh), fh=fh,
     )
@@ -235,8 +253,8 @@ def distributed_boost_rounds_scan(
 @partial(jax.jit, static_argnames=("mesh", "obj", "obj_fp", "cfg",
                                    "d_local", "fh"))
 def _dist_scan_impl(bins, label, weight, margin, iters, cut_values, eta,
-                    gamma, feature_weights, seed_base, n_arr, *, mesh, obj,
-                    obj_fp, cfg, d_local, fh):
+                    gamma, feature_weights, seed_base, n_arr, onehot, *,
+                    mesh, obj, obj_fp, cfg, d_local, fh):
     import dataclasses
 
     import jax.numpy as jnp
@@ -252,7 +270,7 @@ def _dist_scan_impl(bins, label, weight, margin, iters, cut_values, eta,
     rows_local = n_pad // D
     B = cut_values.shape[1]
 
-    def shard_fn(bins_s, label_s, weight_s, m_s, fw, n_a):
+    def shard_fn(bins_s, label_s, weight_s, m_s, fw, n_a, oh_s):
         r = jax.lax.axis_index(ROW_AXIS)
         # shard r belongs to process r // d_local; its real-row budget is
         # that process's count, measured within the process's block
@@ -262,7 +280,11 @@ def _dist_scan_impl(bins, label, weight, margin, iters, cut_values, eta,
                  + jax.lax.broadcasted_iota(jnp.int32, (rows_local, 1), 0)[:, 0]
                  ) < n_own
         validf = valid.astype(jnp.float32)
-        onehot_s = build_onehot(bins_s[:, :fh], B=B) if fh else None
+        if oh_s is not None:
+            onehot_s = oh_s
+        else:
+            onehot_s = (build_onehot(bins_s[:, :fh], B=B, vma=(ROW_AXIS,))
+                        if fh else None)
 
         def body(m_loc, i):
             m = m_loc[:, 0] if K == 1 else m_loc
@@ -301,6 +323,12 @@ def _dist_scan_impl(bins, label, weight, margin, iters, cut_values, eta,
         args.append(None)
     in_specs.append(P())
     args.append(n_arr)
+    if onehot is not None:
+        in_specs.append(P(ROW_AXIS, None))
+        args.append(onehot)
+    else:
+        in_specs.append(None)
+        args.append(None)
     fn = jax.shard_map(
         shard_fn, mesh=mesh, in_specs=tuple(in_specs),
         out_specs=(P(ROW_AXIS, None), tree_specs),

@@ -41,6 +41,16 @@ def current_mesh() -> Optional[Mesh]:
     return getattr(_state, "mesh", None)
 
 
+def collective_active() -> bool:
+    """True only when COLLECTIVE multi-process semantics apply: several
+    processes AND an active ``mesh_context``. Shared by the learner's
+    training routing and the metrics' distributed reductions so they can
+    never disagree — a program that merely initialized jax.distributed but
+    trains mesh-less per-process boosters must see purely local behavior
+    everywhere (no surprise allgathers inside metric evaluation)."""
+    return jax.process_count() > 1 and current_mesh() is not None
+
+
 @contextlib.contextmanager
 def mesh_context(mesh: Optional[Mesh]) -> Iterator[None]:
     """Activate a mesh: training inside the context shards rows over it."""

@@ -164,17 +164,76 @@ def can_hoist(n_pad: int, F: int, B: int, max_depth: int = 6) -> bool:
     return hoist_plan(n_pad, F, B, max_depth) == F
 
 
+_BUILD_VMEM_BUDGET = 10 * 1024 * 1024  # double-buffered out tile + bins
+
+
+def _build_tr(n: int, F: int, B: int) -> int:
+    """Largest row tile (multiple of 256, dividing ``n``) whose build
+    working set — the double-buffered ``[tr, F*B]`` int8 out tile plus the
+    i32 bins tile — fits the VMEM budget. 0 when none does."""
+    for tr in (1024, 512, 256):
+        if n % tr == 0 and tr * F * B * 2 + tr * F * 4 <= _BUILD_VMEM_BUDGET:
+            return tr
+    return 0
+
+
+def _build_onehot_body(bins_ref, out_ref, *, F: int, B: int):
+    binsb = bins_ref[:, :]  # [tr, F] i32
+    iota_b = jax.lax.broadcasted_iota(jnp.int32, (binsb.shape[0], B), 1)
+    for f in range(F):
+        col = binsb[:, f:f + 1]
+        out_ref[:, f * B:(f + 1) * B] = (col == iota_b).astype(jnp.int8)
+
+
+@functools.partial(jax.jit, static_argnames=("B", "tr", "vma"))
+def _build_onehot_pallas(bins: jax.Array, *, B: int, tr: int,
+                         vma=()) -> jax.Array:
+    """Tile-local build: each row-tile grid step compares its i32 bins
+    columns against an iota entirely in VMEM and stores the int8 tile, so
+    peak HBM is the int8 output itself. The XLA broadcast build instead
+    materializes the ``[n, F, B]`` *s32 compare intermediate* (4
+    bytes/entry, 4x the output) — at the headline 1M x 34 x 256
+    partial-hoist shape a 26 GB allocation that cannot fit any chip."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n, F = bins.shape
+    return pl.pallas_call(
+        functools.partial(_build_onehot_body, F=F, B=B),
+        grid=(n // tr,),
+        in_specs=[
+            pl.BlockSpec((tr, F), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((tr, F * B), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=_vma_struct((n, F * B), jnp.int8, vma),
+        interpret=_INTERPRET,
+    )(bins.astype(jnp.int32))
+
+
 @functools.partial(jax.jit, static_argnames=("B",))
-def build_onehot(bins: jax.Array, *, B: int) -> jax.Array:
-    """[n, F] narrow-int bins -> [n, F*B] int8 one-hot (missing bin ``B``
-    maps to an all-zero row, so missing rows drop out of histograms exactly
-    like the in-kernel construction). Built by XLA (which takes narrow
-    compares happily — it is Mosaic that rejects sub-32-bit iota), one time
-    per training run."""
+def _build_onehot_xla(bins: jax.Array, *, B: int) -> jax.Array:
     n, F = bins.shape
     iota = jnp.arange(B, dtype=jnp.int32)
     oh = (bins.astype(jnp.int32)[:, :, None] == iota[None, None, :])
     return oh.astype(jnp.int8).reshape(n, F * B)
+
+
+def build_onehot(bins: jax.Array, *, B: int, vma=()) -> jax.Array:
+    """[n, F] narrow-int bins -> [n, F*B] int8 one-hot (missing bin ``B``
+    maps to an all-zero row, so missing rows drop out of histograms exactly
+    like the in-kernel construction). Built once per training run; on TPU
+    via a Pallas tile kernel whose peak HBM footprint is the output alone
+    (see ``_build_onehot_pallas``), elsewhere by XLA broadcast-compare
+    (small shapes only — tests, narrow matrices). ``vma`` annotates the
+    output's varying axes when building inside ``shard_map``."""
+    n, F = bins.shape
+    if use_pallas() or _INTERPRET:
+        tr = _build_tr(n, F, B)
+        if F > 0 and tr:
+            return _build_onehot_pallas(bins, B=B, tr=tr, vma=vma)
+    return _build_onehot_xla(bins, B=B)
 
 
 def _split_hilo(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
