@@ -20,6 +20,7 @@ def _clean_watchdog_env():
     yield
     os.environ.pop("XGBTPU_BENCH_DEADLINE_AT", None)
     os.environ.pop("XGBTPU_BENCH_CPU_FALLBACK", None)
+    os.environ.pop("XGBTPU_HOIST_BUDGET_MB", None)
 
 
 def test_bench_produces_json_line():
@@ -221,3 +222,38 @@ def test_bench_hanging_jax_still_emits(tmp_path):
     assert rec["metric"] == "train_time_failed"
     # the probe expired (twice) and the re-exec path was taken
     assert "re-exec with JAX_PLATFORMS=cpu" in out.stderr
+
+
+def test_bench_hoist_ladder_before_row_halving(tmp_path, monkeypatch, capsys):
+    """Hard failures first walk the hoist-budget ladder (library default ->
+    2048 MB -> disabled) at UNCHANGED row count — a full-scale number with
+    a smaller hoist beats a quarter-scale number — and only then halve
+    rows."""
+    import bench
+
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.delenv("XGBTPU_HOIST_BUDGET_MB", raising=False)
+    calls = []
+
+    def fake_train(xgb, X, y, params, rounds, budget_s, chunk=25,
+                   test_size=0.25, eval_rows=25_000, on_chunk=None):
+        b = os.environ.get("XGBTPU_HOIST_BUDGET_MB")
+        calls.append((len(X), b))
+        if len(X) <= 4000:  # smoke workload: always succeeds
+            return rounds, 0.5, 0.9
+        if b != "0":  # synthetic chip too small for any resident hoist
+            raise RuntimeError("RESOURCE_EXHAUSTED (synthetic)")
+        return rounds, 10.0, 0.9
+
+    monkeypatch.setattr(bench, "_train_measured", fake_train)
+    monkeypatch.setattr(bench, "_release_device_memory", lambda: None)
+    monkeypatch.setattr(sys, "argv", [
+        "bench.py", "--no_probe", "--rows", "20000", "--iterations", "8",
+        "--smoke_rows", "4000", "--tuned_max_bin", "0"])
+    bench.main()
+    out = [ln for ln in capsys.readouterr().out.splitlines() if ln.strip()]
+    rec = json.loads(out[-1])
+    assert "20kx50" in rec["metric"], rec  # rows never halved
+    assert rec["value"] == 10.0
+    big = [b for (n, b) in calls if n == 20000]
+    assert big == [None, "2048", "0"], calls
