@@ -61,6 +61,19 @@ s = collective.allreduce(np.array([float(rank + 1)]), collective.Op.SUM)
 assert float(s[0]) == 3.0, s
 m = collective.allreduce(np.array([float(rank)]), collective.Op.MAX)
 assert float(m[0]) == 1.0, m
+
+# mesh-LESS multi-process: with jax.distributed initialized but no
+# mesh_context, training and metrics must be purely LOCAL — DART is
+# outside the scan envelope (would raise under a mesh), and the ranks
+# evaluate a DIFFERENT number of times, so any hidden collective in
+# either path would raise or deadlock here (collective_active gate)
+d_loc = xgb.DMatrix(X[lo:hi], label=y[lo:hi])
+bst_loc = xgb.train({"objective": "binary:logistic", "booster": "dart",
+                     "max_depth": 3, "eta": 0.3, "max_bin": 16,
+                     "seed": rank}, d_loc, num_boost_round=3)
+for _ in range(rank + 1):
+    ev = bst_loc.eval(d_loc)
+assert isinstance(ev, str) and "logloss" in ev, ev
 print(f"rank {rank} done", flush=True)
 """
 
