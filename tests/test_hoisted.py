@@ -355,3 +355,30 @@ def test_build_tr_vmem_model():
     assert tr256 in (256, 512) and 750592 % tr256 == 0
     assert hk._build_tr(1000, 5, 16) == 0  # not a multiple of 256
     assert hk._build_tr(1024, 4096, 256) == 0  # tile can never fit
+
+
+def test_hoist_build_failure_degrades(monkeypatch):
+    """A failing on-device one-hot build (e.g. a Mosaic reject of the int8
+    tile store — hardware-unproven until the relay heals) must degrade to
+    the construct path (fused_onehot -> None), latched so the build is not
+    retried every call, instead of failing the fit."""
+    import xgboost_tpu as xgb
+    from xgboost_tpu.tree import hist_kernel as hk
+
+    rng = np.random.RandomState(3)
+    X = rng.randn(1024, 4).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    binned = xgb.DMatrix(X, label=y).get_binned(16, None)
+
+    calls = {"n": 0}
+
+    def boom(*a, **k):
+        calls["n"] += 1
+        raise RuntimeError("synthetic mosaic reject")
+
+    monkeypatch.setattr(hk, "use_pallas", lambda: True)  # plan != 0 on CPU
+    monkeypatch.setattr(hk, "build_onehot", boom)
+    assert binned.fused_onehot(3) is None
+    assert binned._onehot_failed
+    assert binned.fused_onehot(3) is None  # latched: no per-call retry
+    assert calls["n"] == 1

@@ -265,6 +265,10 @@ class BinnedMatrix:
     # mesh twin: row-sharded one-hot, keyed by mesh id — built once per
     # (fit, mesh), NOT once per tree (VERDICT r4 weak #5)
     _onehot_mesh: Optional[Tuple[int, Optional[jax.Array]]] = None
+    # latched when the hoist build itself fails on-device (e.g. a Mosaic
+    # reject of the int8 tile store): training proceeds on the construct
+    # kernel instead of crashing, and the build is not retried per call
+    _onehot_failed: bool = False
     # frozen process-synced hoist plan, keyed by mesh id: ONE allgather
     # per (fit, mesh), never per chunk — and immune to free-HBM drift
     # flipping a jit static arg mid-fit
@@ -308,6 +312,8 @@ class BinnedMatrix:
         # the plan, and rebuild every round (thrash + transient 2x HBM).
         if self._onehot is not None:
             return self._onehot
+        if self._onehot_failed:
+            return None
         fh = hoist_plan(n_pad, self.n_features, B, max_depth)
         if fh == 0:
             return None
@@ -321,7 +327,18 @@ class BinnedMatrix:
             f"tpu_hist: hoisted one-hot active — {gb:.2f} GB "
             f"HBM-resident ({n_pad}x{fh}x{B} int8){part}; "
             "levels stream it through the MXU")
-        self._onehot = build_onehot(bins[:, :fh], B=B)
+        try:
+            self._onehot = build_onehot(bins[:, :fh], B=B)
+        except Exception as e:
+            # e.g. a Mosaic compile reject of the tile build on this
+            # runtime: degrade to the in-kernel construct path rather
+            # than failing the fit, and don't retry per call
+            self._onehot_failed = True
+            console_logger.warning(
+                f"tpu_hist: hoisted one-hot build failed "
+                f"({type(e).__name__}: {e}); training on the in-kernel "
+                "construction path instead")
+            return None
         return self._onehot
 
     def fused_onehot_mesh(self, mesh, max_depth: int = 6
@@ -341,14 +358,41 @@ class BinnedMatrix:
 
         if self._onehot_mesh is not None and self._onehot_mesh[0] == id(mesh):
             return self._onehot_mesh[1]
+        if self._onehot_failed:
+            return None
         binsf, n_pad = self.fused_bins_mesh(mesh)
         B = self.cuts.max_bin
         fh = self.hoist_plan_mesh(mesh, max_depth)
         if fh:
-            oh = jax.shard_map(
-                lambda b: build_onehot(b[:, :fh], B=B, vma=(ROW_AXIS,)),
-                mesh=mesh, in_specs=P(ROW_AXIS, None),
-                out_specs=P(ROW_AXIS, None))(binsf)
+            try:
+                oh = jax.shard_map(
+                    lambda b: build_onehot(b[:, :fh], B=B, vma=(ROW_AXIS,)),
+                    mesh=mesh, in_specs=P(ROW_AXIS, None),
+                    out_specs=P(ROW_AXIS, None))(binsf)
+            except Exception as e:
+                # same degrade as fused_onehot: a build failure must not
+                # fail the fit
+                self._onehot_failed = True
+                from ..utils import console_logger
+
+                console_logger.warning(
+                    f"tpu_hist: mesh hoisted one-hot build failed "
+                    f"({type(e).__name__}: {e}); training on the "
+                    "in-kernel construction path instead")
+                oh = None
+            if jax.process_count() > 1:
+                # ranks must AGREE on whether the expansion exists (it
+                # shapes the SPMD program): if any rank's build failed
+                # (e.g. an asymmetric OOM), all ranks drop to construct
+                import numpy as _np
+
+                from jax.experimental import multihost_utils
+
+                ok_all = _np.asarray(multihost_utils.process_allgather(
+                    _np.asarray(0 if oh is None else 1, _np.int64)))
+                if int(ok_all.min()) == 0 and oh is not None:
+                    self._onehot_failed = True
+                    oh = None
         else:
             oh = None
         self._onehot_mesh = (id(mesh), oh)
