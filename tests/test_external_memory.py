@@ -244,14 +244,15 @@ def test_foreign_booster_on_paged_matrix_warns(tmp_path):
         bst_foreign.predict(d_ext)
 
 
-def test_local_histmaker_warns():
-    """grow_local_histmaker is an honest alias: selecting it warns that
-    per-node re-sketching (updater_histmaker.cc:25) is not performed."""
+def test_local_histmaker_rejects_paged():
+    """grow_local_histmaker re-sketches from raw values per node
+    (tree/grow_local.py) and therefore needs in-memory data; an
+    external-memory matrix is rejected with a clear error."""
     import pytest
 
-    rng = np.random.RandomState(0)
-    X = rng.randn(200, 4).astype(np.float32)
-    y = (X[:, 0] > 0).astype(np.float32)
-    with pytest.warns(UserWarning, match="re-sketching"):
+    parts, labels, _ = _make()
+    d_ext = xgb.ExternalMemoryQuantileDMatrix(
+        _ArrayIter(parts, labels), max_bin=16, page_rows=1024)
+    with pytest.raises(NotImplementedError, match="in-memory"):
         xgb.train({"updater": "grow_local_histmaker"},
-                  xgb.DMatrix(X, label=y), 2, verbose_eval=False)
+                  d_ext, 2, verbose_eval=False)

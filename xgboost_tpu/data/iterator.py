@@ -127,6 +127,10 @@ class StreamingQuantileDMatrix(DMatrix):
             self.info.group_ptr = _group_ptr_from_qid(np.concatenate(qparts))
         self._binned = {max_bin: BinnedMatrix(cuts=cuts, bins=bins)}
 
+    #: consumers needing TRUE raw values (e.g. grow_local_histmaker's
+    #: per-node re-sketch) must refuse this matrix: ``data`` is quantized
+    data_is_reconstructed = True
+
     @property
     def data(self):
         """Representative feature values reconstructed from bins (the

@@ -889,18 +889,6 @@ class GBTree:
                 name = name.strip()
                 if name and name not in self._KNOWN_UPDATERS:
                     raise ValueError(f"Unknown updater: {name!r}")
-                if name == "grow_local_histmaker":
-                    # honest alias notice: the reference re-SKETCHES per
-                    # node (updater_histmaker.cc:25,753); here it maps onto
-                    # the global-proposal grower — same split family, no
-                    # per-node cut refresh (VERDICT r4 missing #5)
-                    import warnings
-
-                    warnings.warn(
-                        "grow_local_histmaker runs as the global-proposal "
-                        "tpu_hist grower: per-node histogram re-sketching "
-                        "is not implemented; cuts are the global quantile "
-                        "proposals", UserWarning)
                 if name:
                     self._updater_seq.append(name)
             roles = {self._KNOWN_UPDATERS[u] for u in self._updater_seq}
@@ -966,6 +954,16 @@ class GBTree:
             self.gbtree_param.tree_method == "exact"
             or "grow_colmaker" in getattr(self, "_updater_seq", [])
         )
+
+    @property
+    def needs_local_sketch(self) -> bool:
+        """``updater='grow_local_histmaker'``: per-NODE hessian-weighted
+        cut re-proposal every level (``src/tree/updater_histmaker.cc:753``
+        CQHistMaker / registration :25) — the grower re-sketches each
+        expand node's rows and evaluates it against its OWN cuts
+        (``tree/grow_local.py``), unlike the global per-iteration proposal
+        of ``approx``."""
+        return "grow_local_histmaker" in getattr(self, "_updater_seq", [])
 
     @property
     def needs_iteration_sketch(self) -> bool:
@@ -1171,6 +1169,65 @@ class GBTree:
                     delta = jnp.asarray(lmap_np)[positions]
                     if use_mesh and delta.shape[0] != binned.n_rows:
                         delta = delta[: binned.n_rows]  # drop inert padding
+                    if margin_cache.ndim == 2:
+                        margin_cache = margin_cache.at[:, k].add(delta)
+                    else:
+                        margin_cache = margin_cache + delta
+        return new_trees, margin_cache
+
+    # ------------------------------------------------------------------
+    def local_boost_one_round(self, X, grad, hess, iteration, margin_cache,
+                              feature_weights=None):
+        """One boosting round via the LOCAL histmaker
+        (``updater='grow_local_histmaker'``): trees grow on RAW values with
+        per-node re-sketched cuts (``tree/grow_local.py``) instead of the
+        global quantized matrix. Same model/caching contract as the legacy
+        ``boost_one_round`` loop."""
+        from ..parallel.mesh import current_mesh
+        from ..tree.grow_local import grow_tree_local
+
+        tp = self.train_param
+        mesh = current_mesh()
+        if mesh is not None and mesh.devices.size > 1:
+            raise NotImplementedError(
+                "grow_local_histmaker is single-process/single-device; "
+                "use tree_method='tpu_hist' under a mesh")
+        if tp.grow_policy == "lossguide":
+            raise NotImplementedError(
+                "grow_local_histmaker is depthwise (the reference's "
+                "histmaker family has no lossguide variant)")
+        cfg = self._grow_params()
+        X = jnp.asarray(X, jnp.float32)
+        new_trees: List[RegTree] = []
+        for k in range(self.n_groups):
+            g = grad[:, k] if grad.ndim == 2 else grad
+            h = hess[:, k] if hess.ndim == 2 else hess
+            for ptree in range(self.gbtree_param.num_parallel_tree):
+                key = jax.random.PRNGKey(
+                    round_seed_py(tp.seed, iteration, k, ptree))
+                fw = (jnp.asarray(feature_weights)
+                      if feature_weights is not None else None)
+                heap = grow_tree_local(X, g, h, key, cfg, tp.max_bin, fw)
+                is_split = np.asarray(heap.is_split)
+                loss_chg = np.asarray(heap.loss_chg)
+                pruned = prune_heap(is_split, loss_chg, tp.gamma)
+                tree = RegTree.from_heap(
+                    pruned,
+                    np.asarray(heap.feature),
+                    np.asarray(heap.split_cond),
+                    np.asarray(heap.default_left),
+                    np.asarray(heap.node_weight),
+                    loss_chg,
+                    np.asarray(heap.node_h),
+                    eta=tp.eta,
+                    split_bin=np.asarray(heap.split_bin),
+                )
+                lmap_np = leaf_value_map(pruned, np.asarray(heap.node_weight),
+                                         tp.eta)
+                self.model.add(tree, k)
+                new_trees.append(tree)
+                if margin_cache is not None:
+                    delta = jnp.asarray(lmap_np)[heap.positions]
                     if margin_cache.ndim == 2:
                         margin_cache = margin_cache.at[:, k].add(delta)
                     else:

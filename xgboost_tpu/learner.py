@@ -230,6 +230,7 @@ class Booster:
         if (
             self._gbm.name == "gbtree"
             and not getattr(self._gbm, "needs_iteration_sketch", False)
+            and not getattr(self._gbm, "needs_local_sketch", False)
             and not getattr(self._gbm, "needs_exact_cuts", False)
             and dtrain.info.label is not None
         ):
@@ -284,6 +285,46 @@ class Booster:
                         dtrain.data, grad, hess, iteration
                     )
                 entry.margin = None  # leaf values changed
+                return
+            if getattr(self._gbm, "needs_local_sketch", False):
+                # updater=grow_local_histmaker: per-node re-sketched cuts,
+                # grown from RAW values — no global quantized matrix
+                # (updater_histmaker.cc:753)
+                if self._gbm.name != "gbtree":
+                    raise NotImplementedError(
+                        "grow_local_histmaker is a gbtree updater")
+                if getattr(dtrain, "data_is_reconstructed", False):
+                    # a QuantileDMatrix's .data is bin-reconstructed (at
+                    # most max_bin distinct values/feature): re-sketching
+                    # it would silently lose exactly the sub-bin
+                    # resolution this updater exists for. The reference's
+                    # QuantileDMatrix is likewise hist-only.
+                    raise NotImplementedError(
+                        "grow_local_histmaker needs TRUE raw values; a "
+                        "QuantileDMatrix only holds quantized bins — "
+                        "construct a DMatrix instead")
+                try:
+                    X_raw = dtrain.data  # paged matrices refuse this
+                except NotImplementedError:
+                    X_raw = None
+                if X_raw is None:
+                    raise NotImplementedError(
+                        "grow_local_histmaker needs in-memory data for "
+                        "per-node re-sketching")
+                if dtrain.categorical_features():
+                    raise NotImplementedError(
+                        "grow_local_histmaker supports numerical features "
+                        "only (the reference's local maker predates "
+                        "categorical support)")
+                with self.monitor.section("BoostOneRound"):
+                    _, new_margin = self._gbm.local_boost_one_round(
+                        X_raw, grad, hess, iteration, entry.margin,
+                        feature_weights=dtrain.info.feature_weights)
+                if new_margin is not None:
+                    entry.margin = new_margin
+                    entry.num_trees = self._gbm.model.num_trees
+                else:
+                    entry.margin = None
                 return
             with self.monitor.section("GetBinned"):
                 if getattr(self._gbm, "needs_iteration_sketch", False):

@@ -1,0 +1,330 @@
+"""grow_local_histmaker: per-NODE hessian-weighted re-sketch each level.
+
+The reference's LOCAL histmaker (``src/tree/updater_histmaker.cc:753``
+``CQHistMaker`` under ``grow_local_histmaker``; registration :25) differs
+from the global-proposal family in ONE way: at every level it re-sketches
+the candidate cuts **per expand node** from the hessian-weighted values of
+the rows currently IN that node (``ResetPosAndPropose`` →
+``UpdateSketchCol``, one WXQuantile sketch per (node, feature)), then
+histograms and evaluates each node against its OWN cuts. Deep nodes
+therefore keep full split resolution inside their shrinking value ranges —
+the property a fixed global proposal loses.
+
+TPU-native formulation: no per-node sketch objects and no data-dependent
+shapes. Each level runs, per feature (``lax.map``, bounded memory):
+
+1. a SEGMENTED weighted quantile — one ``lexsort`` by (node, value), one
+   cumsum, and a batched ``searchsorted`` at the per-node quantile targets
+   — producing ``[nodes, B]`` cut values with exactly the global sketch's
+   conventions (``data/quantile.py:_cuts_kernel``: B-1 interior weighted
+   quantiles + a strict-upper sentinel);
+2. re-binning of every row against ITS node's cuts (a gather of the node's
+   cut row + a ``<=`` count, the searchsorted-right identity of
+   ``_bin_kernel``), missing (NaN) to the overflow bin.
+
+The level histogram, split evaluation (the shared ``eval_splits``),
+monotone/interaction handling, column/row sampling, child pre-writes, and
+routing are exactly ``grow_tree``'s — split conditions are real values
+(each node's own cut), so the resulting ``HeapTree`` materializes into the
+same ``RegTree`` and the standard predictor applies unchanged.
+
+Scope mirrors the reference's: numerical features only (the reference's
+local maker predates categorical support), single-process (the reference
+computes local sketches per worker then allreduces summaries; distributed
+users should prefer hist — as the reference itself advises, the method is
+deprecated upstream in favor of global proposals).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .grow import (
+    GrowParams,
+    HeapTree,
+    RT_EPS,
+    apply_row_sampling,
+    blocked_histogram,
+    calc_weight,
+    child_bounds_and_weights,
+    eval_splits,
+    exact_k_subset,
+    interaction_allowed,
+    _sample_features_exact,
+)
+
+__all__ = ["grow_tree_local", "segmented_weighted_cuts"]
+
+_INF = float(np.inf)
+_BIG = float(np.finfo(np.float32).max)
+
+
+def segmented_weighted_cuts(col: jax.Array, weight: jax.Array,
+                            seg: jax.Array, K: int, B: int) -> jax.Array:
+    """Weighted quantile cuts of one feature column, PER SEGMENT:
+    ``[K, B]`` = B-1 interior weighted quantiles + strict-upper sentinel
+    for each of K segments (same conventions as the global
+    ``_cuts_kernel``). ``seg`` in ``[0, K)`` selects a segment; anything
+    else (inactive rows) and NaN values are excluded. Zero-weight segments
+    get the degenerate monotone dummy cut set the global sketch uses."""
+    n = col.shape[0]
+    nan = jnp.isnan(col)
+    s = jnp.where(nan | (seg < 0) | (seg >= K), K, seg)  # K = trash
+    v = jnp.where(nan, _BIG, col)
+    w = jnp.where(s == K, 0.0, weight)
+
+    # sort by (segment, value): lexsort's LAST key is primary
+    order = jnp.lexsort((v, s))
+    s_s = s[order]
+    v_s = v[order]
+    w_s = w[order]
+    c = jnp.cumsum(w_s)  # globally nondecreasing; in-segment CDF via offsets
+
+    ones = jnp.ones((n,), jnp.int32)
+    cnt = jax.ops.segment_sum(ones, s_s, num_segments=K + 1)[:K]
+    istart = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                              jnp.cumsum(cnt)[:-1].astype(jnp.int32)])
+    iend = istart + cnt  # [K] sorted-order row ranges per segment
+
+    Wseg = jax.ops.segment_sum(w_s, s_s, num_segments=K + 1)[:K]
+    cstart = jnp.concatenate([jnp.zeros((1,), jnp.float32),
+                              jnp.cumsum(Wseg)[:-1]])
+
+    # per-(segment, j) targets at j/B of the segment's total weight
+    levels = jnp.arange(1, B, dtype=jnp.float32) / B  # [B-1]
+    tgt = cstart[:, None] + levels[None, :] * Wseg[:, None]  # [K, B-1]
+    idx = jnp.searchsorted(c, tgt.reshape(-1), side="left").reshape(K, B - 1)
+    # clamp into the owning segment (ties at boundaries, empty segments)
+    idx = jnp.clip(idx, istart[:, None],
+                   jnp.maximum(iend[:, None] - 1, istart[:, None]))
+    interior = v_s[jnp.clip(idx, 0, n - 1)]  # [K, B-1]
+
+    vmax = v_s[jnp.clip(iend - 1, 0, n - 1)]
+    vmax = jnp.where(cnt > 0, vmax, 0.0)
+    sentinel = vmax + jnp.maximum(1.0, jnp.abs(vmax))
+    interior = jnp.where((cnt > 0)[:, None], interior, 0.0)
+    return jnp.concatenate([interior, sentinel[:, None]], axis=1)  # [K, B]
+
+
+def _level_cuts_and_bins(X: jax.Array, hess: jax.Array, seg: jax.Array,
+                         K: int, B: int):
+    """All features' per-node cuts ``[K, F, B]`` and per-row bins
+    ``[n, F]`` (each row binned against ITS node's cuts; NaN and
+    inactive-row bins land in the overflow bin ``B``). ``lax.map`` over
+    features bounds peak memory at O(n·B) — the [n, F, B] broadcast a
+    vmap would materialize is the exact blow-up the histogram path
+    avoids too (``blocked_histogram``)."""
+    segc = jnp.clip(seg, 0, K - 1)
+
+    def per_feature(col):
+        cuts_f = segmented_weighted_cuts(col, hess, seg, K, B)  # [K, B]
+        rowcuts = cuts_f[segc]  # [n, B] each row's own node's cuts
+        b = jnp.sum((rowcuts <= col[:, None]).astype(jnp.int32), axis=1)
+        b = jnp.clip(b, 0, B - 1)  # searchsorted-right identity
+        b = jnp.where(jnp.isnan(col), jnp.int32(B), b)
+        return cuts_f, b
+
+    cuts, bins = jax.lax.map(per_feature, X.T)  # [F, K, B], [F, n]
+    return jnp.transpose(cuts, (1, 0, 2)), bins.T.astype(jnp.int32)
+
+
+def grow_tree_local(
+    X: jax.Array,  # [n, F] RAW float32 values (NaN = missing)
+    grad: jax.Array,  # [n] f32
+    hess: jax.Array,  # [n] f32
+    key: jax.Array,
+    cfg: GrowParams,
+    max_bin: int,
+    feature_weights: Optional[jax.Array] = None,
+) -> HeapTree:
+    if cfg.has_categorical:
+        raise NotImplementedError(
+            "grow_local_histmaker supports numerical features only "
+            "(the reference's local maker predates categorical support)")
+    if cfg.axis_name is not None:
+        raise NotImplementedError(
+            "grow_local_histmaker is single-process; use "
+            "tree_method='hist'/'tpu_hist' for distributed training")
+    n, F = X.shape
+    B = max_bin
+    MB = B + 1
+    p = cfg.split
+    max_depth = cfg.max_depth
+    Nmax = cfg.level_width
+    max_nodes = cfg.max_nodes
+    X = jnp.asarray(X, jnp.float32)
+
+    k_sub, k_ctree, k_level = jax.random.split(key, 3)
+    grad, hess = apply_row_sampling(cfg, k_sub, grad, hess)
+
+    if cfg.colsample_bytree < 1.0:
+        tree_mask = _sample_features_exact(k_ctree, F, cfg.colsample_bytree,
+                                           feature_weights)
+    else:
+        tree_mask = jnp.ones((F,), bool)
+
+    if cfg.has_monotone:
+        mono = np.zeros(F, np.int32)
+        mono[: len(cfg.monotone)] = cfg.monotone[:F]
+        mono_j = jnp.asarray(mono)
+    if cfg.has_interaction:
+        gmask_np = np.zeros((len(cfg.interaction), F), bool)
+        for gi, grp in enumerate(cfg.interaction):
+            for f in grp:
+                if f < F:
+                    gmask_np[gi, f] = True
+        gmask = jnp.asarray(gmask_np)
+
+    gh = jnp.stack([grad, hess], axis=-1)
+
+    def body(d: jax.Array, state):
+        (pos, is_split, feature, split_bin, split_cond, default_left,
+         node_g, node_h, node_w, loss_chg, lo_b, up_b, used) = state
+
+        offset = (1 << d) - 1
+        width = 1 << d
+        local = pos - offset
+        level_active = (local >= 0) & (local < width)
+        seg = jnp.where(level_active, local, -1)
+
+        # ---- the one difference from grow_tree: fresh per-node cuts ----
+        cuts_lvl, bins_lvl = _level_cuts_and_bins(X, hess, seg, Nmax, B)
+
+        hist = blocked_histogram(bins_lvl, gh, seg, Nmax, MB)
+        Gtot = hist[:, 0, :, 0].sum(-1)
+        Htot = hist[:, 0, :, 1].sum(-1)
+
+        slots = offset + jnp.arange(Nmax)
+        slot_real = jnp.arange(Nmax) < width
+        widx = jnp.where(slot_real, slots, max_nodes)
+        node_lo = lo_b[widx.clip(0, max_nodes - 1)]
+        node_up = up_b[widx.clip(0, max_nodes - 1)]
+
+        k_tree = max(1, int(round(cfg.colsample_bytree * F))) \
+            if cfg.colsample_bytree < 1.0 else F
+        fmask = tree_mask
+        if cfg.colsample_bylevel < 1.0:
+            k_lvl = max(1, int(round(cfg.colsample_bylevel * k_tree)))
+            fmask = exact_k_subset(jax.random.fold_in(k_level, d), fmask,
+                                   k_lvl)
+        else:
+            k_lvl = k_tree
+        if cfg.colsample_bynode < 1.0:
+            k_nd = max(1, int(round(cfg.colsample_bynode * k_lvl)))
+            kn = jax.random.fold_in(jax.random.fold_in(k_level, d), 1)
+            node_fmask = exact_k_subset(
+                kn, jnp.broadcast_to(fmask[None, :], (Nmax, F)), k_nd)
+        else:
+            node_fmask = jnp.broadcast_to(fmask[None, :], (Nmax, F))
+        if cfg.has_interaction:
+            node_used = used[widx.clip(0, max_nodes - 1)]
+            node_fmask = node_fmask & interaction_allowed(node_used, gmask)
+
+        dec = eval_splits(
+            hist, Gtot, Htot, p, node_fmask, B,
+            mono=mono_j if cfg.has_monotone else None,
+            node_lo=node_lo if cfg.has_monotone else None,
+            node_up=node_up if cfg.has_monotone else None,
+        )
+        best_loss, best_dir, best_f, best_b = dec.loss, dec.dir, dec.f, dec.b
+        w_node = dec.w_node
+        can_split = (best_loss > RT_EPS) & (Htot > 0.0) & slot_real
+        GLb, HLb = dec.GL, dec.HL
+        GRb, HRb = Gtot - GLb, Htot - HLb
+
+        # each node's OWN cut value is the split condition
+        cond = cuts_lvl[jnp.arange(Nmax), best_f, best_b]
+
+        is_split = is_split.at[widx].set(can_split, mode="drop")
+        feature = feature.at[widx].set(best_f, mode="drop")
+        split_bin = split_bin.at[widx].set(best_b, mode="drop")
+        split_cond = split_cond.at[widx].set(cond, mode="drop")
+        default_left = default_left.at[widx].set(best_dir == 1, mode="drop")
+        node_g = node_g.at[widx].set(Gtot, mode="drop")
+        node_h = node_h.at[widx].set(Htot, mode="drop")
+        node_w = node_w.at[widx].set(w_node, mode="drop")
+        loss_chg = loss_chg.at[widx].set(
+            jnp.where(can_split, best_loss, 0.0), mode="drop")
+
+        if cfg.has_monotone:
+            l_lo, l_up, r_lo, r_up, wl_c, wr_c = child_bounds_and_weights(
+                p, mono_j[best_f], GLb, HLb, GRb, HRb, node_lo, node_up)
+        else:
+            wl_c = calc_weight(GLb, HLb, p)
+            wr_c = calc_weight(GRb, HRb, p)
+
+        lidx = jnp.where(can_split, 2 * slots + 1, max_nodes)
+        ridx = jnp.where(can_split, 2 * slots + 2, max_nodes)
+        node_g = node_g.at[lidx].set(GLb, mode="drop").at[ridx].set(
+            GRb, mode="drop")
+        node_h = node_h.at[lidx].set(HLb, mode="drop").at[ridx].set(
+            HRb, mode="drop")
+        node_w = node_w.at[lidx].set(wl_c, mode="drop").at[ridx].set(
+            wr_c, mode="drop")
+        if cfg.has_monotone:
+            lo_b = lo_b.at[lidx].set(l_lo, mode="drop").at[ridx].set(
+                r_lo, mode="drop")
+            up_b = up_b.at[lidx].set(l_up, mode="drop").at[ridx].set(
+                r_up, mode="drop")
+        if cfg.has_interaction:
+            child_used = used[widx.clip(0, max_nodes - 1)] | jax.nn.one_hot(
+                best_f, F, dtype=bool)
+            used = used.at[lidx].set(child_used, mode="drop")
+            used = used.at[ridx].set(child_used, mode="drop")
+
+        # route on the per-node bins (bin <= b ⟺ value < the node's cut)
+        goes = is_split[pos]
+        f_of = feature[pos]
+        b_of = split_bin[pos]
+        dl_of = default_left[pos]
+        bv = jnp.take_along_axis(bins_lvl, f_of[:, None], axis=1)[:, 0]
+        missing = bv == B
+        goleft = jnp.where(missing, dl_of, bv <= b_of)
+        pos = jnp.where(goes, jnp.where(goleft, 2 * pos + 1, 2 * pos + 2),
+                        pos)
+
+        return (pos, is_split, feature, split_bin, split_cond, default_left,
+                node_g, node_h, node_w, loss_chg, lo_b, up_b, used)
+
+    n_b = max_nodes if cfg.has_monotone else 1
+    n_u = max_nodes if cfg.has_interaction else 1
+    init = (
+        jnp.zeros((n,), jnp.int32),
+        jnp.zeros((max_nodes,), bool),
+        jnp.zeros((max_nodes,), jnp.int32),
+        jnp.zeros((max_nodes,), jnp.int32),
+        jnp.zeros((max_nodes,), jnp.float32),
+        jnp.zeros((max_nodes,), bool),
+        jnp.zeros((max_nodes,), jnp.float32),
+        jnp.zeros((max_nodes,), jnp.float32),
+        jnp.zeros((max_nodes,), jnp.float32),
+        jnp.zeros((max_nodes,), jnp.float32),
+        jnp.full((n_b,), -_INF),
+        jnp.full((n_b,), _INF),
+        jnp.zeros((n_u, F), bool),
+    )
+    if max_depth == 0:
+        state = init
+        G, H = grad.sum(), hess.sum()
+        state = (
+            state[0], state[1], state[2], state[3], state[4], state[5],
+            state[6].at[0].set(G), state[7].at[0].set(H),
+            state[8].at[0].set(calc_weight(G, H, p)), state[9],
+            state[10], state[11], state[12],
+        )
+    else:
+        state = jax.lax.fori_loop(0, max_depth, body, init)
+
+    (pos, is_split, feature, split_bin, split_cond, default_left,
+     node_g, node_h, node_w, loss_chg, _, _, _) = state
+    return HeapTree(
+        is_split=is_split, feature=feature, split_bin=split_bin,
+        split_cond=split_cond, default_left=default_left,
+        node_g=node_g, node_h=node_h, node_weight=node_w,
+        loss_chg=loss_chg, positions=pos,
+        cat_set=jnp.zeros((1, 1), bool),
+    )
