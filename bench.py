@@ -177,7 +177,13 @@ def _probe_backend(timeout_s: float | None = None) -> str | None:
     the pool (docs/perf.md) — only a truly stuck probe should expire."""
     if timeout_s is None:
         timeout_s = float(os.environ.get("XGBTPU_BENCH_PROBE_TIMEOUT", "240"))
-    code = "import jax; print('BK=' + jax.default_backend())"
+    # a real dispatch + host readback, not just backend init: the observed
+    # round-5 wedge mode ATTACHES fine and hangs at the first dispatch, so
+    # probing default_backend() alone would pass and the bench would then
+    # wedge inside the smoke run (watchdog line, but no number)
+    code = ("import jax, jax.numpy as jnp; "
+            "v = float(jnp.ones((8, 128)).sum()); "
+            "print('BK=' + jax.default_backend())")
     for attempt in (1, 2):
         try:
             r = subprocess.run([sys.executable, "-c", code],
