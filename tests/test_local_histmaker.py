@@ -192,3 +192,22 @@ def test_rejects_quantile_dmatrix():
         xgb.train({"objective": "binary:logistic",
                    "updater": "grow_local_histmaker", "verbosity": 0},
                   d, 1)
+
+
+def test_multiclass_and_parallel_trees():
+    """K groups x num_parallel_tree trees per round through the local
+    grower; softprob gradients are [n, K]."""
+    rng = np.random.RandomState(4)
+    n = 1500
+    X = rng.randn(n, 5).astype(np.float32)
+    y = (X[:, 0] > 0.3).astype(np.float32) + (X[:, 1] > 0).astype(
+        np.float32)  # 3 classes
+    params = {"objective": "multi:softprob", "num_class": 3, "max_depth": 4,
+              "eta": 0.4, "updater": "grow_local_histmaker", "max_bin": 16,
+              "num_parallel_tree": 2, "seed": 1, "verbosity": 0}
+    bst = xgb.train(params, xgb.DMatrix(X, label=y), 4)
+    p = np.asarray(bst.predict(xgb.DMatrix(X)))
+    assert p.shape == (n, 3)
+    np.testing.assert_allclose(p.sum(1), 1.0, rtol=1e-5)
+    acc = (p.argmax(1) == y).mean()
+    assert acc > 0.85, acc
