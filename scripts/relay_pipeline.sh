@@ -1,7 +1,8 @@
 #!/bin/bash
-# When the relay answers, run the measurement pipeline: microbench then
-# full bench. One TPU process at a time, generous budgets, never killed
-# mid-claim (a killed claim wedges the pool). Attach probes are the only
+# When the relay answers, run the measurement pipeline: full bench FIRST
+# (the deliverable — bank the number), then the microbench diagnostics.
+# One TPU process at a time, generous budgets, never killed mid-claim
+# (a killed claim wedges the pool). Attach probes are the only
 # timeout-killed steps — they hold no allocations, and a wedged attach
 # is exactly what the probe is for.
 LOG=${1:-/tmp/relay_pipeline.log}
@@ -9,22 +10,23 @@ cd /root/repo || exit 1
 echo "[$(date +%H:%M:%S)] pipeline start" >> "$LOG"
 while true; do
   echo "[$(date +%H:%M:%S)] attach probe" >> "$LOG"
-  timeout 900 python -c "
+  timeout 600 python -c "
 import jax, jax.numpy as jnp
 d = jax.devices()[0]
 print(float(jnp.ones((128,128)).sum()), d, flush=True)
 " >> "$LOG" 2>&1
   if [ $? -eq 0 ]; then
-    echo "[$(date +%H:%M:%S)] relay HEALTHY — running microbench" >> "$LOG"
+    echo "[$(date +%H:%M:%S)] relay HEALTHY — running bench.py" >> "$LOG"
+    XGBTPU_BENCH_PARTIAL=/tmp/bench_partial_r5.jsonl \
+      XGBTPU_BENCH_DEADLINE=2400 \
+      python bench.py > /tmp/bench_r5.out 2> /tmp/bench_r5.err
+    echo "[$(date +%H:%M:%S)] bench rc=$? — running microbench" >> "$LOG"
     PYTHONPATH=/root/repo python scripts/tpu_microbench.py \
       > /tmp/microbench_r5.log 2>&1
-    echo "[$(date +%H:%M:%S)] microbench rc=$? — running bench.py" >> "$LOG"
-    XGBTPU_BENCH_PARTIAL=/tmp/bench_partial_r5.jsonl \
-      python bench.py > /tmp/bench_r5.out 2> /tmp/bench_r5.err
-    echo "[$(date +%H:%M:%S)] bench rc=$?" >> "$LOG"
+    echo "[$(date +%H:%M:%S)] microbench rc=$?" >> "$LOG"
     echo "[$(date +%H:%M:%S)] pipeline done" >> "$LOG"
     exit 0
   fi
-  echo "[$(date +%H:%M:%S)] attach failed; backoff 300s" >> "$LOG"
-  sleep 300
+  echo "[$(date +%H:%M:%S)] attach failed; backoff 600s" >> "$LOG"
+  sleep 600
 done
