@@ -603,3 +603,90 @@ def test_golden_rank_map_gpair():  # test_ranking_obj.cc:108
     np.testing.assert_allclose(g, [0.475, -0.475, 0.475, -0.475],
                                atol=0.01)
     np.testing.assert_allclose(h, [0.4988] * 4, atol=0.01)
+
+
+def test_golden_refresh_updater_stats(tmp_path):
+    """Transcription of the reference's refresh-updater fixture
+    (tests/cpp/tree/test_refresh.cc:18-57): 8 rows with gpairs
+    4x(0.23,0.24) + 4x(0.27,0.29), a depth-1 tree routing exactly ONE
+    (0.27,0.29) row left, reg_lambda=1, reg_alpha=0, eta=0.3.
+    Expected after refresh: right leaf -0.183392, root loss_chg
+    -0.224489 — the latter REQUIRES CalcGain's min_child_weight zero
+    rule (param.h:262: the 1-row left child's hessian 0.29 < 1 makes its
+    gain 0, not 0.0565), which this fixture caught missing. The left
+    leaf gets weight 0 by CalcWeight's twin rule (param.h:249). The
+    tree is injected via a crafted reference-schema model file, exactly
+    as the reference test builds it by hand (a gain-negative split that
+    training would never produce)."""
+    import json
+
+    import xgboost_tpu as xgb
+
+    grads = np.array([0.23] * 4 + [0.27] * 4, np.float32)
+    hesss = np.array([0.24] * 4 + [0.29] * 4, np.float32)
+    X = np.full((8, 3), 0.5, np.float32)
+    X[:, 2] = 0.3
+    X[4, 2] = 0.1  # the one (0.27, 0.29) row that goes left (0.1 < 0.2)
+
+    model = {
+        "version": [1, 6, 0],
+        "learner": {
+            "attributes": {}, "feature_names": [], "feature_types": [],
+            "gradient_booster": {
+                "model": {
+                    "gbtree_model_param": {"num_trees": "1",
+                                           "size_leaf_vector": "0"},
+                    "tree_info": [0],
+                    "trees": [{
+                        "base_weights": [0.0, 0.0, 0.0],
+                        "categories": [], "categories_nodes": [],
+                        "categories_segments": [], "categories_sizes": [],
+                        "default_left": [0, 0, 0],
+                        "id": 0,
+                        "left_children": [1, -1, -1],
+                        "loss_changes": [0.0, 0.0, 0.0],
+                        "parents": [2147483647, 0, 0],
+                        "right_children": [2, -1, -1],
+                        "split_conditions": [0.2, 0.0, 0.0],
+                        "split_indices": [2, 0, 0],
+                        "split_type": [0, 0, 0],
+                        "sum_hessian": [0.0, 0.0, 0.0],
+                        "tree_param": {"num_deleted": "0",
+                                       "num_feature": "3",
+                                       "num_nodes": "3",
+                                       "size_leaf_vector": "0"},
+                    }],
+                },
+                "name": "gbtree",
+            },
+            "learner_model_param": {"base_score": "0", "num_class": "0",
+                                    "num_feature": "3"},
+            "objective": {"name": "reg:squarederror",
+                          "reg_loss_param": {"scale_pos_weight": "1"}},
+        },
+    }
+    path = tmp_path / "fixture_tree.json"
+    path.write_text(json.dumps(model))
+    base = xgb.Booster(model_file=str(path))
+
+    def fobj(pred, dtrain):
+        return grads, hesss
+
+    d = xgb.DMatrix(X, label=np.zeros(8, np.float32))
+    upd = xgb.train({"max_depth": 1, "process_type": "update",
+                     "refresh_leaf": 1, "reg_lambda": 1.0, "reg_alpha": 0.0,
+                     "eta": 0.3, "verbosity": 0}, d, 1, obj=fobj,
+                    xgb_model=base)
+    t = upd._gbm.model.trees[0]
+    left, right = t.left_children[0], t.right_children[0]
+    assert left != -1 and t.split_indices[0] == 2
+    # right child: 4x(0.23,0.24) + 3x(0.27,0.29) -> -0.3 * 1.73/2.83
+    np.testing.assert_allclose(t.split_conditions[right], -0.183392,
+                               atol=1e-6)
+    # left child: hessian 0.29 < min_child_weight -> weight 0
+    np.testing.assert_allclose(t.split_conditions[left], 0.0, atol=1e-7)
+    # root loss_chg: 0 (left gain zeroed) + 1.73^2/2.83 - 2.0^2/3.12
+    np.testing.assert_allclose(t.loss_changes[0], -0.224489, atol=1e-6)
+    np.testing.assert_allclose(t.loss_changes[left], 0.0, atol=1e-7)
+    np.testing.assert_allclose(t.loss_changes[right], 0.0, atol=1e-7)
+    np.testing.assert_allclose(t.sum_hessian[0], 2.12, atol=1e-6)

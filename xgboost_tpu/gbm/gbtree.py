@@ -1290,6 +1290,20 @@ class GBTree:
                             jnp.asarray(H, jnp.float32), p)
             )
             tree.base_weights = (eta * w).astype(np.float32)
+            # refresh loss_chg too: gain(L) + gain(R) - gain(self) on the
+            # NEW stats for internal nodes, 0 for leaves
+            # (updater_refresh.cc:148-151; pinned by the golden fixture —
+            # CalcGain's min_child_weight zero rule included)
+            from ..tree.param import calc_gain
+
+            gains = np.asarray(calc_gain(jnp.asarray(G, jnp.float32),
+                                         jnp.asarray(H, jnp.float32), p))
+            internal = tree.left_children != -1
+            lc = np.where(internal, tree.left_children, 0)
+            rc = np.where(internal, tree.right_children, 0)
+            tree.loss_changes = np.where(
+                internal, gains[lc] + gains[rc] - gains, 0.0
+            ).astype(np.float32)
             if tp.refresh_leaf:
                 leaf_mask = tree.left_children == -1
                 tree.split_conditions = np.where(

@@ -40,20 +40,30 @@ def threshold_l1(g: jnp.ndarray, alpha: float) -> jnp.ndarray:
 
 
 def calc_weight(G: jnp.ndarray, H: jnp.ndarray, p: SplitParams) -> jnp.ndarray:
+    # reference param.h:249: a node whose hessian mass is below
+    # min_child_weight (or non-positive) gets weight 0 — this is what the
+    # reference's refresh/leaf stats produce for degenerate nodes (split
+    # CANDIDATES never hit it: the evaluator's validity mask already
+    # requires H >= min_child_weight on both children)
     denom = H + p.reg_lambda
     w = jnp.where(denom > 0.0, -threshold_l1(G, p.reg_alpha) / jnp.maximum(denom, 1e-38), 0.0)
     if p.max_delta_step > 0.0:
         w = jnp.clip(w, -p.max_delta_step, p.max_delta_step)
-    return w
+    return jnp.where((H < p.min_child_weight) | (H <= 0.0), 0.0, w)
 
 
 def calc_gain(G: jnp.ndarray, H: jnp.ndarray, p: SplitParams) -> jnp.ndarray:
+    # reference param.h:262: gain is 0 below min_child_weight (pinned by
+    # the refresh golden fixture: a 1-row child's gain contributes 0 to
+    # the parent's recomputed loss_chg)
     denom = H + p.reg_lambda
     if p.max_delta_step == 0.0:
         t = threshold_l1(G, p.reg_alpha)
-        return jnp.where(denom > 0.0, t * t / jnp.maximum(denom, 1e-38), 0.0)
-    w = calc_weight(G, H, p)
-    return -(2.0 * G * w + denom * w * w)
+        g = jnp.where(denom > 0.0, t * t / jnp.maximum(denom, 1e-38), 0.0)
+    else:
+        w = calc_weight(G, H, p)
+        g = -(2.0 * G * w + denom * w * w)
+    return jnp.where(H < p.min_child_weight, 0.0, g)
 
 
 def calc_gain_given_weight(
