@@ -29,10 +29,17 @@ _pc_lib: Optional[ctypes.CDLL] = None
 _pc_tried = False
 
 
-def _build() -> bool:
-    cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-o", _LIB_PATH, _SRC]
+def _compile(src: str, lib_path: str, extra: list, timeout: int = 120) -> bool:
+    """Build ``lib_path`` from ``src`` when stale (single-sourced
+    staleness + existence logic for all three on-demand libraries).
+    True when a usable library exists afterwards."""
+    if not os.path.exists(src):
+        return os.path.exists(lib_path)  # prebuilt-only deployment
+    if os.path.exists(lib_path) and             os.path.getmtime(lib_path) >= os.path.getmtime(src):
+        return True
+    cmd = ["g++", "-shared", "-fPIC", "-o", lib_path, src] + extra
     try:
-        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        subprocess.run(cmd, check=True, capture_output=True, timeout=timeout)
         return True
     except Exception:
         return False
@@ -46,16 +53,9 @@ def get_pagecache_lib() -> Optional[ctypes.CDLL]:
         if _pc_lib is not None or _pc_tried:
             return _pc_lib
         _pc_tried = True
-        if not os.path.exists(_PC_LIB) or (
-            os.path.exists(_PC_SRC)
-            and os.path.getmtime(_PC_SRC) > os.path.getmtime(_PC_LIB)
-        ):
-            cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
-                   "-o", _PC_LIB, _PC_SRC]
-            try:
-                subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-            except Exception:
-                return None
+        if not _compile(_PC_SRC, _PC_LIB,
+                        ["-O3", "-std=c++17", "-pthread"]):
+            return None
         try:
             lib = ctypes.CDLL(_PC_LIB)
         except OSError:
@@ -83,12 +83,8 @@ def get_lib() -> Optional[ctypes.CDLL]:
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        if not os.path.exists(_LIB_PATH) or (
-            os.path.exists(_SRC)
-            and os.path.getmtime(_SRC) > os.path.getmtime(_LIB_PATH)
-        ):
-            if not _build():
-                return None
+        if not _compile(_SRC, _LIB_PATH, ["-O3", "-march=native"]):
+            return None
         try:
             lib = ctypes.CDLL(_LIB_PATH)
         except OSError:
@@ -171,3 +167,41 @@ def load_csv_native(path: str) -> Optional[Tuple[np.ndarray, np.ndarray]]:
     y = out[:, 0].copy()
     X = np.ascontiguousarray(out[:, 1:])
     return X, y
+
+
+_CAPI_SRC = os.path.join(_HERE, "c_api.cpp")
+_CAPI_LIB = os.path.join(_HERE, "libxgbtpu.so")
+_capi_path: Optional[str] = None
+_capi_tried = False
+
+
+def build_capi() -> Optional[str]:
+    """Build (if stale) and return the path of the embedded-interpreter C
+    API library ``libxgbtpu.so`` (reference ABI: include/xgboost/c_api.h).
+    None when the toolchain or Python embedding flags are unavailable.
+    Returns the PATH rather than a loaded CDLL: C hosts dlopen it
+    themselves, and the ctypes test loads it explicitly."""
+    global _capi_path, _capi_tried
+    with _lock:
+        if _capi_path is not None or _capi_tried:
+            return _capi_path
+        _capi_tried = True
+        import sysconfig
+
+        repo_root = os.path.dirname(os.path.dirname(_HERE))
+        paths = sysconfig.get_paths()
+        site = paths.get("purelib", "")
+        inc = paths["include"]
+        libdir = sysconfig.get_config_var("LIBDIR") or ""
+        pyver = sysconfig.get_config_var("LDVERSION") or \
+            sysconfig.get_config_var("VERSION") or ""
+        if not _compile(_CAPI_SRC, _CAPI_LIB,
+                        ["-O2", "-std=c++17", f"-I{inc}",
+                         f'-DXGBTPU_ROOT="{repo_root}"',
+                         f'-DXGBTPU_SITE="{site}"',
+                         f"-L{libdir}", f"-lpython{pyver}",
+                         f"-Wl,-rpath,{libdir}", "-ldl", "-lm"],
+                        timeout=180):
+            return None
+        _capi_path = _CAPI_LIB if os.path.exists(_CAPI_LIB) else None
+        return _capi_path

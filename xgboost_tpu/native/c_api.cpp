@@ -1,0 +1,560 @@
+// C API for xgboost_tpu — the reference's include/xgboost/c_api.h surface
+// (signature-compatible core subset) realized over the Python-first TPU
+// runtime by EMBEDDING CPython: each exported function acquires the GIL
+// (initializing an interpreter first when the host process is not Python —
+// e.g. a C program dlopen'ing this library) and forwards to the
+// xgboost_tpu package. This is the reverse of the reference's layering
+// (its Python package wraps libxgboost.so; here the native library wraps
+// the Python package) but presents the same ABI to C callers:
+//   XGBGetLastError                  c_api.h:64
+//   XGDMatrixCreateFromMat           c_api.h:186
+//   XGDMatrixCreateFromFile          c_api.h:132
+//   XGDMatrixSetFloatInfo/GetFloatInfo, SetUIntInfo
+//   XGDMatrixNumRow/NumCol/Free
+//   XGBoosterCreate/Free/SetParam    c_api.h:747,760,795
+//   XGBoosterUpdateOneIter           c_api.h:807
+//   XGBoosterBoostOneIter            c_api.h:820
+//   XGBoosterEvalOneIter             c_api.h:835
+//   XGBoosterPredict                 c_api.h:865 (option_mask 0/1)
+//   XGBoosterSaveModel/LoadModel, XGBoosterGetNumFeature
+//   XGBoosterSetAttr/GetAttr, XGBVersion
+// Error contract matches the reference: every call returns 0 on success,
+// -1 on failure with the message retrievable via XGBGetLastError().
+//
+// Build (native/__init__.py:load_capi): g++ -shared -fPIC c_api.cpp
+//   $(python3-config --includes) $(python3-config --ldflags --embed)
+//   -DXGBTPU_ROOT=... -DXGBTPU_SITE=...
+
+#include <Python.h>
+
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#define XGB_DLL extern "C" __attribute__((visibility("default")))
+
+typedef uint64_t bst_ulong;
+typedef void *DMatrixHandle;
+typedef void *BoosterHandle;
+
+static thread_local std::string g_last_error;
+
+#ifndef XGBTPU_ROOT
+#define XGBTPU_ROOT ""
+#endif
+#ifndef XGBTPU_SITE
+#define XGBTPU_SITE ""
+#endif
+
+static void ensure_python() {
+  static std::once_flag once;
+  std::call_once(once, [] {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+      // the embedded interpreter must see the venv's site-packages (jax,
+      // numpy) and the repo root (xgboost_tpu); both are baked at build
+      // time and overridable via the environment
+      PyRun_SimpleString(
+          "import sys, os\n"
+          "for p in (os.environ.get('XGBTPU_SITE', '" XGBTPU_SITE "'),\n"
+          "          os.environ.get('XGBTPU_ROOT', '" XGBTPU_ROOT "')):\n"
+          "    if p and p not in sys.path:\n"
+          "        sys.path.insert(0, p)\n");
+      // release the GIL the initializer holds: every API entry point
+      // re-acquires via PyGILState_Ensure (works for foreign threads too)
+      PyEval_SaveThread();
+    }
+  });
+}
+
+namespace {
+
+struct Gil {
+  PyGILState_STATE st;
+  Gil() {
+    ensure_python();
+    st = PyGILState_Ensure();
+  }
+  ~Gil() { PyGILState_Release(st); }
+};
+
+int fail() {  // capture the live Python exception into g_last_error
+  PyObject *t = nullptr, *v = nullptr, *tb = nullptr;
+  PyErr_Fetch(&t, &v, &tb);
+  PyErr_NormalizeException(&t, &v, &tb);
+  g_last_error = "unknown error";
+  if (v != nullptr) {
+    PyObject *s = PyObject_Str(v);
+    if (s != nullptr) {
+      const char *c = PyUnicode_AsUTF8(s);
+      if (c != nullptr) g_last_error = c;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(t);
+  Py_XDECREF(v);
+  Py_XDECREF(tb);
+  return -1;
+}
+
+int fail_msg(const char *msg) {
+  PyErr_Clear();
+  g_last_error = msg;
+  return -1;
+}
+
+// borrowed-module helper (Python caches imports; no refcount juggling of
+// long-lived module objects across handles)
+PyObject *imp(const char *name) { return PyImport_ImportModule(name); }
+
+struct MatWrap {
+  PyObject *obj;  // xgboost_tpu.DMatrix
+  std::vector<float> finfo;  // GetFloatInfo out-buffer
+};
+
+struct BoosterWrap {
+  PyObject *obj;  // xgboost_tpu.Booster
+  std::vector<float> pred;  // XGBoosterPredict out-buffer
+  std::string eval_out;     // XGBoosterEvalOneIter out-string
+  std::string attr_out;     // XGBoosterGetAttr out-string
+};
+
+// call a method with an already-built args tuple; returns new ref or null
+PyObject *call(PyObject *o, const char *meth, PyObject *args) {
+  PyObject *m = PyObject_GetAttrString(o, meth);
+  if (m == nullptr) return nullptr;
+  PyObject *r = PyObject_CallObject(m, args);
+  Py_DECREF(m);
+  return r;
+}
+
+// float buffer -> numpy float32 array (copy), shaped [n] or [rows, cols]
+PyObject *np_from(const float *data, bst_ulong n, bst_ulong rows = 0,
+                  bst_ulong cols = 0) {
+  PyObject *np = imp("numpy");
+  if (np == nullptr) return nullptr;
+  PyObject *mv = PyMemoryView_FromMemory(
+      reinterpret_cast<char *>(const_cast<float *>(data)),
+      static_cast<Py_ssize_t>(n * sizeof(float)), PyBUF_READ);
+  if (mv == nullptr) return nullptr;
+  PyObject *r = PyObject_CallMethod(np, "frombuffer", "Os", mv, "float32");
+  Py_DECREF(mv);
+  if (r == nullptr) return nullptr;
+  PyObject *copy = PyObject_CallMethod(r, "copy", nullptr);
+  Py_DECREF(r);
+  if (copy == nullptr) return nullptr;
+  if (rows != 0) {
+    PyObject *shaped = PyObject_CallMethod(
+        copy, "reshape", "(nn)", static_cast<Py_ssize_t>(rows),
+        static_cast<Py_ssize_t>(cols));
+    Py_DECREF(copy);
+    return shaped;
+  }
+  return copy;
+}
+
+// DMatrix.set_info is keyword-only: call set_info(**{field: value})
+int set_info_kw(PyObject *dmat, const char *field, PyObject *value) {
+  PyObject *meth = PyObject_GetAttrString(dmat, "set_info");
+  PyObject *args = PyTuple_New(0);
+  PyObject *kw = PyDict_New();
+  if (meth == nullptr || args == nullptr || kw == nullptr) {
+    Py_XDECREF(meth);
+    Py_XDECREF(args);
+    Py_XDECREF(kw);
+    return fail();
+  }
+  PyDict_SetItemString(kw, field, value);
+  PyObject *r = PyObject_Call(meth, args, kw);
+  Py_DECREF(meth);
+  Py_DECREF(args);
+  Py_DECREF(kw);
+  if (r == nullptr) return fail();
+  Py_DECREF(r);
+  return 0;
+}
+
+// numpy array -> this->buf (float32 ravel); returns 0/-1
+int np_to(PyObject *arr, std::vector<float> *buf) {
+  PyObject *np = imp("numpy");
+  if (np == nullptr) return fail();
+  PyObject *flat = PyObject_CallMethod(np, "ascontiguousarray", "Os", arr,
+                                       "float32");
+  if (flat == nullptr) return fail();
+  PyObject *rav = PyObject_CallMethod(flat, "ravel", nullptr);
+  Py_DECREF(flat);
+  if (rav == nullptr) return fail();
+  PyObject *bytes = PyObject_CallMethod(rav, "tobytes", nullptr);
+  Py_ssize_t nb = 0;
+  char *raw = nullptr;
+  if (bytes == nullptr || PyBytes_AsStringAndSize(bytes, &raw, &nb) != 0) {
+    Py_XDECREF(bytes);
+    Py_DECREF(rav);
+    return fail();
+  }
+  buf->resize(static_cast<size_t>(nb) / sizeof(float));
+  std::memcpy(buf->data(), raw, static_cast<size_t>(nb));
+  Py_DECREF(bytes);
+  Py_DECREF(rav);
+  return 0;
+}
+
+}  // namespace
+
+XGB_DLL const char *XGBGetLastError(void) { return g_last_error.c_str(); }
+
+XGB_DLL void XGBVersion(int *major, int *minor, int *patch) {
+  if (major) *major = 2;
+  if (minor) *minor = 0;
+  if (patch) *patch = 0;
+}
+
+// ---------------------------------------------------------------- DMatrix
+
+XGB_DLL int XGDMatrixCreateFromMat(const float *data, bst_ulong nrow,
+                                   bst_ulong ncol, float missing,
+                                   DMatrixHandle *out) {
+  Gil gil;
+  PyObject *arr = np_from(data, nrow * ncol, nrow, ncol);
+  if (arr == nullptr) return fail();
+  // reference semantics: entries equal to `missing` are treated missing
+  // (NaN missing needs no rewrite — NaN == NaN is false anyway)
+  if (!std::isnan(missing)) {
+    PyObject *np = imp("numpy");
+    PyObject *nan = PyFloat_FromDouble(NAN);
+    PyObject *m = PyFloat_FromDouble(static_cast<double>(missing));
+    PyObject *eq = PyObject_CallMethod(arr, "__eq__", "O", m);
+    PyObject *where = (np && nan && eq)
+        ? PyObject_CallMethod(np, "where", "OOO", eq, nan, arr) : nullptr;
+    Py_XDECREF(eq);
+    Py_XDECREF(m);
+    Py_XDECREF(nan);
+    Py_DECREF(arr);
+    if (where == nullptr) return fail();
+    PyObject *f32 = PyObject_CallMethod(where, "astype", "s", "float32");
+    Py_DECREF(where);
+    if (f32 == nullptr) return fail();
+    arr = f32;
+  }
+  PyObject *mod = imp("xgboost_tpu");
+  if (mod == nullptr) {
+    Py_DECREF(arr);
+    return fail();
+  }
+  PyObject *d = PyObject_CallMethod(mod, "DMatrix", "O", arr);
+  Py_DECREF(arr);
+  if (d == nullptr) return fail();
+  auto *w = new MatWrap{d, {}};
+  *out = w;
+  return 0;
+}
+
+XGB_DLL int XGDMatrixCreateFromFile(const char *fname, int /*silent*/,
+                                    DMatrixHandle *out) {
+  Gil gil;
+  PyObject *mod = imp("xgboost_tpu");
+  if (mod == nullptr) return fail();
+  PyObject *d = PyObject_CallMethod(mod, "DMatrix", "s", fname);
+  if (d == nullptr) return fail();
+  *out = new MatWrap{d, {}};
+  return 0;
+}
+
+XGB_DLL int XGDMatrixSetFloatInfo(DMatrixHandle handle, const char *field,
+                                  const float *data, bst_ulong len) {
+  Gil gil;
+  auto *w = static_cast<MatWrap *>(handle);
+  PyObject *arr = np_from(data, len);
+  if (arr == nullptr) return fail();
+  int rc = set_info_kw(w->obj, field, arr);
+  Py_DECREF(arr);
+  return rc;
+}
+
+XGB_DLL int XGDMatrixSetUIntInfo(DMatrixHandle handle, const char *field,
+                                 const unsigned *data, bst_ulong len) {
+  Gil gil;
+  auto *w = static_cast<MatWrap *>(handle);
+  std::vector<float> f(data, data + len);
+  PyObject *arr = np_from(f.data(), len);
+  if (arr == nullptr) return fail();
+  PyObject *i32 = PyObject_CallMethod(arr, "astype", "s", "int64");
+  Py_DECREF(arr);
+  if (i32 == nullptr) return fail();
+  int rc = set_info_kw(w->obj, field, i32);
+  Py_DECREF(i32);
+  return rc;
+}
+
+XGB_DLL int XGDMatrixGetFloatInfo(DMatrixHandle handle, const char *field,
+                                  bst_ulong *out_len,
+                                  const float **out_dptr) {
+  Gil gil;
+  auto *w = static_cast<MatWrap *>(handle);
+  PyObject *r = PyObject_CallMethod(w->obj, "get_float_info", "s", field);
+  if (r == nullptr) return fail();
+  int rc = np_to(r, &w->finfo);
+  Py_DECREF(r);
+  if (rc != 0) return rc;
+  *out_len = static_cast<bst_ulong>(w->finfo.size());
+  *out_dptr = w->finfo.data();
+  return 0;
+}
+
+XGB_DLL int XGDMatrixNumRow(DMatrixHandle handle, bst_ulong *out) {
+  Gil gil;
+  auto *w = static_cast<MatWrap *>(handle);
+  PyObject *r = PyObject_CallMethod(w->obj, "num_row", nullptr);
+  if (r == nullptr) return fail();
+  *out = static_cast<bst_ulong>(PyLong_AsUnsignedLongLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+XGB_DLL int XGDMatrixNumCol(DMatrixHandle handle, bst_ulong *out) {
+  Gil gil;
+  auto *w = static_cast<MatWrap *>(handle);
+  PyObject *r = PyObject_CallMethod(w->obj, "num_col", nullptr);
+  if (r == nullptr) return fail();
+  *out = static_cast<bst_ulong>(PyLong_AsUnsignedLongLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+XGB_DLL int XGDMatrixFree(DMatrixHandle handle) {
+  Gil gil;
+  auto *w = static_cast<MatWrap *>(handle);
+  Py_XDECREF(w->obj);
+  delete w;
+  return 0;
+}
+
+// ---------------------------------------------------------------- Booster
+
+XGB_DLL int XGBoosterCreate(const DMatrixHandle dmats[], bst_ulong len,
+                            BoosterHandle *out) {
+  Gil gil;
+  PyObject *mod = imp("xgboost_tpu");
+  if (mod == nullptr) return fail();
+  PyObject *cache = PyList_New(static_cast<Py_ssize_t>(len));
+  if (cache == nullptr) return fail();
+  for (bst_ulong i = 0; i < len; ++i) {
+    PyObject *o = static_cast<MatWrap *>(dmats[i])->obj;
+    Py_INCREF(o);
+    PyList_SET_ITEM(cache, static_cast<Py_ssize_t>(i), o);
+  }
+  PyObject *params = PyDict_New();
+  PyObject *b = PyObject_CallMethod(mod, "Booster", "OO", params, cache);
+  Py_DECREF(params);
+  Py_DECREF(cache);
+  if (b == nullptr) return fail();
+  *out = new BoosterWrap{b, {}, {}, {}};
+  return 0;
+}
+
+XGB_DLL int XGBoosterFree(BoosterHandle handle) {
+  Gil gil;
+  auto *w = static_cast<BoosterWrap *>(handle);
+  Py_XDECREF(w->obj);
+  delete w;
+  return 0;
+}
+
+XGB_DLL int XGBoosterSetParam(BoosterHandle handle, const char *name,
+                              const char *value) {
+  Gil gil;
+  auto *w = static_cast<BoosterWrap *>(handle);
+  PyObject *r = PyObject_CallMethod(w->obj, "set_param", "ss", name, value);
+  if (r == nullptr) return fail();
+  Py_DECREF(r);
+  return 0;
+}
+
+XGB_DLL int XGBoosterUpdateOneIter(BoosterHandle handle, int iter,
+                                   DMatrixHandle dtrain) {
+  Gil gil;
+  auto *w = static_cast<BoosterWrap *>(handle);
+  auto *d = static_cast<MatWrap *>(dtrain);
+  PyObject *r = PyObject_CallMethod(w->obj, "update", "Oi", d->obj, iter);
+  if (r == nullptr) return fail();
+  Py_DECREF(r);
+  return 0;
+}
+
+XGB_DLL int XGBoosterBoostOneIter(BoosterHandle handle, DMatrixHandle dtrain,
+                                  float *grad, float *hess, bst_ulong len) {
+  Gil gil;
+  auto *w = static_cast<BoosterWrap *>(handle);
+  auto *d = static_cast<MatWrap *>(dtrain);
+  PyObject *g = np_from(grad, len);
+  PyObject *h = g != nullptr ? np_from(hess, len) : nullptr;
+  if (g == nullptr || h == nullptr) {
+    Py_XDECREF(g);
+    Py_XDECREF(h);
+    return fail();
+  }
+  PyObject *r = PyObject_CallMethod(w->obj, "boost", "OOO", d->obj, g, h);
+  Py_DECREF(g);
+  Py_DECREF(h);
+  if (r == nullptr) return fail();
+  Py_DECREF(r);
+  return 0;
+}
+
+XGB_DLL int XGBoosterEvalOneIter(BoosterHandle handle, int iter,
+                                 DMatrixHandle dmats[],
+                                 const char *evnames[], bst_ulong len,
+                                 const char **out_result) {
+  Gil gil;
+  auto *w = static_cast<BoosterWrap *>(handle);
+  PyObject *evals = PyList_New(static_cast<Py_ssize_t>(len));
+  if (evals == nullptr) return fail();
+  for (bst_ulong i = 0; i < len; ++i) {
+    PyObject *pair = Py_BuildValue(
+        "(Os)", static_cast<MatWrap *>(dmats[i])->obj, evnames[i]);
+    if (pair == nullptr) {
+      Py_DECREF(evals);
+      return fail();
+    }
+    PyList_SET_ITEM(evals, static_cast<Py_ssize_t>(i), pair);
+  }
+  PyObject *r = PyObject_CallMethod(w->obj, "eval_set", "Oi", evals, iter);
+  Py_DECREF(evals);
+  if (r == nullptr) return fail();
+  const char *s = PyUnicode_AsUTF8(r);
+  if (s == nullptr) {
+    Py_DECREF(r);
+    return fail();
+  }
+  w->eval_out = s;
+  Py_DECREF(r);
+  *out_result = w->eval_out.c_str();
+  return 0;
+}
+
+XGB_DLL int XGBoosterPredict(BoosterHandle handle, DMatrixHandle dmat,
+                             int option_mask, unsigned ntree_limit,
+                             int /*training*/, bst_ulong *out_len,
+                             const float **out_result) {
+  Gil gil;
+  auto *w = static_cast<BoosterWrap *>(handle);
+  auto *d = static_cast<MatWrap *>(dmat);
+  if ((option_mask & ~1) != 0) {
+    return fail_msg(
+        "XGBoosterPredict: only option_mask 0 (value) and 1 "
+        "(output_margin) are supported; use the Python API for "
+        "leaf/contribution predictions");
+  }
+  PyObject *kw = PyDict_New();
+  PyObject *args = Py_BuildValue("(O)", d->obj);
+  PyObject *om = PyBool_FromLong(option_mask & 1);
+  PyObject *meth = PyObject_GetAttrString(w->obj, "predict");
+  int bad = (kw == nullptr || args == nullptr || om == nullptr ||
+             meth == nullptr);
+  if (!bad) {
+    PyDict_SetItemString(kw, "output_margin", om);
+    if (ntree_limit > 0) {
+      PyObject *rng = Py_BuildValue("(ii)", 0,
+                                    static_cast<int>(ntree_limit));
+      if (rng != nullptr) {
+        PyDict_SetItemString(kw, "iteration_range", rng);
+        Py_DECREF(rng);
+      }
+    }
+  }
+  PyObject *r = bad ? nullptr : PyObject_Call(meth, args, kw);
+  Py_XDECREF(meth);
+  Py_XDECREF(om);
+  Py_XDECREF(args);
+  Py_XDECREF(kw);
+  if (r == nullptr) return fail();
+  int rc = np_to(r, &w->pred);
+  Py_DECREF(r);
+  if (rc != 0) return rc;
+  *out_len = static_cast<bst_ulong>(w->pred.size());
+  *out_result = w->pred.data();
+  return 0;
+}
+
+XGB_DLL int XGBoosterSaveModel(BoosterHandle handle, const char *fname) {
+  Gil gil;
+  auto *w = static_cast<BoosterWrap *>(handle);
+  PyObject *r = PyObject_CallMethod(w->obj, "save_model", "s", fname);
+  if (r == nullptr) return fail();
+  Py_DECREF(r);
+  return 0;
+}
+
+XGB_DLL int XGBoosterLoadModel(BoosterHandle handle, const char *fname) {
+  Gil gil;
+  auto *w = static_cast<BoosterWrap *>(handle);
+  PyObject *r = PyObject_CallMethod(w->obj, "load_model", "s", fname);
+  if (r == nullptr) return fail();
+  Py_DECREF(r);
+  return 0;
+}
+
+XGB_DLL int XGBoosterGetNumFeature(BoosterHandle handle, bst_ulong *out) {
+  Gil gil;
+  auto *w = static_cast<BoosterWrap *>(handle);
+  PyObject *r = PyObject_CallMethod(w->obj, "num_features", nullptr);
+  if (r == nullptr) return fail();
+  *out = static_cast<bst_ulong>(PyLong_AsUnsignedLongLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+XGB_DLL int XGBoosterSetAttr(BoosterHandle handle, const char *key,
+                             const char *value) {
+  Gil gil;
+  auto *w = static_cast<BoosterWrap *>(handle);
+  PyObject *kw = PyDict_New();
+  PyObject *args = PyTuple_New(0);
+  PyObject *meth = PyObject_GetAttrString(w->obj, "set_attr");
+  if (kw == nullptr || args == nullptr || meth == nullptr) {
+    Py_XDECREF(kw);
+    Py_XDECREF(args);
+    Py_XDECREF(meth);
+    return fail();
+  }
+  if (value == nullptr) {
+    PyDict_SetItemString(kw, key, Py_None);
+  } else {
+    PyObject *v = PyUnicode_FromString(value);
+    PyDict_SetItemString(kw, key, v);
+    Py_XDECREF(v);
+  }
+  PyObject *r = PyObject_Call(meth, args, kw);
+  Py_DECREF(meth);
+  Py_DECREF(args);
+  Py_DECREF(kw);
+  if (r == nullptr) return fail();
+  Py_DECREF(r);
+  return 0;
+}
+
+XGB_DLL int XGBoosterGetAttr(BoosterHandle handle, const char *key,
+                             const char **out, int *success) {
+  Gil gil;
+  auto *w = static_cast<BoosterWrap *>(handle);
+  PyObject *r = PyObject_CallMethod(w->obj, "attr", "s", key);
+  if (r == nullptr) return fail();
+  if (r == Py_None) {
+    *success = 0;
+    *out = nullptr;
+  } else {
+    const char *s = PyUnicode_AsUTF8(r);
+    if (s == nullptr) {
+      Py_DECREF(r);
+      return fail();
+    }
+    w->attr_out = s;
+    *out = w->attr_out.c_str();
+    *success = 1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
