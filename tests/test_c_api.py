@@ -252,3 +252,75 @@ def test_c_api_from_real_c_host(lib, tmp_path):
     assert out.returncode == 0, (out.stdout, out.stderr[-2000:])
     acc = float(out.stdout.split("C_HOST_ACC=")[1].split()[0])
     assert acc > 0.9, out.stdout
+
+
+def test_c_api_csr_dump_and_buffer_roundtrip(lib, tmp_path):
+    """CSR ingestion (never-densified sparse path), model dump strings,
+    and the save/load-from-buffer pair."""
+    import scipy.sparse as sp
+
+    rng = np.random.RandomState(1)
+    X = sp.random(500, 6, density=0.4, format="csr", random_state=1,
+                  dtype=np.float32)
+    y = (np.asarray(X.sum(axis=1)).ravel() > 0.5).astype(np.float32)
+
+    indptr = np.ascontiguousarray(X.indptr, np.uint64)
+    indices = np.ascontiguousarray(X.indices, np.uint32)
+    vals = np.ascontiguousarray(X.data, np.float32)
+    h = ctypes.c_void_p()
+    lib.XGDMatrixCreateFromCSREx.argtypes = [
+        ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint32),
+        ctypes.POINTER(ctypes.c_float), ctypes.c_size_t, ctypes.c_size_t,
+        ctypes.c_size_t, ctypes.POINTER(ctypes.c_void_p)]
+    _check(lib, lib.XGDMatrixCreateFromCSREx(
+        indptr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        indices.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        vals.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        len(indptr), len(vals), X.shape[1], ctypes.byref(h)))
+    out = ctypes.c_uint64()
+    _check(lib, lib.XGDMatrixNumRow(h, ctypes.byref(out)))
+    assert out.value == 500
+    yl = np.ascontiguousarray(y)
+    _check(lib, lib.XGDMatrixSetFloatInfo(
+        h, b"label", yl.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        len(y)))
+
+    bh = ctypes.c_void_p()
+    mats = (ctypes.c_void_p * 1)(h)
+    _check(lib, lib.XGBoosterCreate(mats, 1, ctypes.byref(bh)))
+    for k, v in [(b"objective", b"binary:logistic"), (b"max_depth", b"3"),
+                 (b"verbosity", b"0"), (b"seed", b"5")]:
+        _check(lib, lib.XGBoosterSetParam(bh, k, v))
+    for it in range(3):
+        _check(lib, lib.XGBoosterUpdateOneIter(bh, it, h))
+
+    # dump: one string per tree, reference text-dump shape
+    dlen = ctypes.c_uint64()
+    darr = ctypes.POINTER(ctypes.c_char_p)()
+    _check(lib, lib.XGBoosterDumpModel(bh, b"", 0, ctypes.byref(dlen),
+                                       ctypes.byref(darr)))
+    assert dlen.value == 3
+    assert b"leaf" in darr[0]
+
+    # buffer round-trip == Python save_raw
+    blen = ctypes.c_uint64()
+    bptr = ctypes.c_char_p()
+    _check(lib, lib.XGBoosterSaveModelToBuffer(bh, b"{}",
+                                               ctypes.byref(blen),
+                                               ctypes.byref(bptr)))
+    raw = ctypes.string_at(bptr, blen.value)
+    bh2 = ctypes.c_void_p()
+    _check(lib, lib.XGBoosterCreate(None, 0, ctypes.byref(bh2)))
+    _check(lib, lib.XGBoosterLoadModelFromBuffer(bh2, raw, len(raw)))
+    plen = ctypes.c_uint64()
+    pptr = ctypes.POINTER(ctypes.c_float)()
+    _check(lib, lib.XGBoosterPredict(bh, h, 0, 0, 0, ctypes.byref(plen),
+                                     ctypes.byref(pptr)))
+    p1 = np.ctypeslib.as_array(pptr, shape=(plen.value,)).copy()
+    _check(lib, lib.XGBoosterPredict(bh2, h, 0, 0, 0, ctypes.byref(plen),
+                                     ctypes.byref(pptr)))
+    p2 = np.ctypeslib.as_array(pptr, shape=(plen.value,)).copy()
+    np.testing.assert_array_equal(p1, p2)
+    _check(lib, lib.XGBoosterFree(bh))
+    _check(lib, lib.XGBoosterFree(bh2))
+    _check(lib, lib.XGDMatrixFree(h))

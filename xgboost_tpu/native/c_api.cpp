@@ -120,6 +120,9 @@ struct BoosterWrap {
   std::vector<float> pred;  // XGBoosterPredict out-buffer
   std::string eval_out;     // XGBoosterEvalOneIter out-string
   std::string attr_out;     // XGBoosterGetAttr out-string
+  std::string raw_out;      // XGBoosterSaveModelToBuffer out-bytes
+  std::vector<std::string> dump;      // XGBoosterDumpModel storage
+  std::vector<const char *> dump_ptrs;
 };
 
 // call a method with an already-built args tuple; returns new ref or null
@@ -329,6 +332,57 @@ XGB_DLL int XGDMatrixFree(DMatrixHandle handle) {
   auto *w = static_cast<MatWrap *>(handle);
   Py_XDECREF(w->obj);
   delete w;
+  return 0;
+}
+
+XGB_DLL int XGDMatrixCreateFromCSREx(const size_t *indptr,
+                                     const unsigned *indices,
+                                     const float *data, size_t nindptr,
+                                     size_t nelem, size_t num_col,
+                                     DMatrixHandle *out) {
+  // c_api.h:114 — CSR ingestion straight into the sparse (never-densified)
+  // storage path via scipy.sparse.csr_matrix
+  Gil gil;
+  PyObject *np = imp("numpy");
+  PyObject *sp = imp("scipy.sparse");
+  PyObject *mod = imp("xgboost_tpu");
+  if (np == nullptr || sp == nullptr || mod == nullptr) return fail();
+  auto arr1d = [&](const void *ptr, size_t n, size_t itemsize,
+                   const char *dtype) -> PyObject * {
+    PyObject *mv = PyMemoryView_FromMemory(
+        reinterpret_cast<char *>(const_cast<void *>(ptr)),
+        static_cast<Py_ssize_t>(n * itemsize), PyBUF_READ);
+    if (mv == nullptr) return nullptr;
+    PyObject *r = PyObject_CallMethod(np, "frombuffer", "Os", mv, dtype);
+    Py_DECREF(mv);
+    if (r == nullptr) return nullptr;
+    PyObject *c = PyObject_CallMethod(r, "copy", nullptr);
+    Py_DECREF(r);
+    return c;
+  };
+  PyObject *pi = arr1d(indptr, nindptr, sizeof(size_t), "uint64");
+  PyObject *px = arr1d(indices, nelem, sizeof(unsigned), "uint32");
+  PyObject *pv = arr1d(data, nelem, sizeof(float), "float32");
+  PyObject *csr = nullptr, *d = nullptr;
+  if (pi != nullptr && px != nullptr && pv != nullptr) {
+    PyObject *inner = Py_BuildValue("(OOO)", pv, px, pi);
+    PyObject *shape = Py_BuildValue(
+        "(nn)", static_cast<Py_ssize_t>(nindptr - 1),
+        static_cast<Py_ssize_t>(num_col));
+    if (inner != nullptr && shape != nullptr) {
+      csr = PyObject_CallMethod(sp, "csr_matrix", "OO", inner, shape);
+    }
+    Py_XDECREF(inner);
+    Py_XDECREF(shape);
+  }
+  Py_XDECREF(pi);
+  Py_XDECREF(px);
+  Py_XDECREF(pv);
+  if (csr == nullptr) return fail();
+  d = PyObject_CallMethod(mod, "DMatrix", "O", csr);
+  Py_DECREF(csr);
+  if (d == nullptr) return fail();
+  *out = new MatWrap{d, {}};
   return 0;
 }
 
@@ -556,5 +610,75 @@ XGB_DLL int XGBoosterGetAttr(BoosterHandle handle, const char *key,
     *success = 1;
   }
   Py_DECREF(r);
+  return 0;
+}
+
+XGB_DLL int XGBoosterSaveModelToBuffer(BoosterHandle handle,
+                                       const char * /*json_config*/,
+                                       bst_ulong *out_len,
+                                       const char **out_dptr) {
+  Gil gil;
+  auto *w = static_cast<BoosterWrap *>(handle);
+  PyObject *r = PyObject_CallMethod(w->obj, "save_raw", "s", "json");
+  if (r == nullptr) return fail();
+  char *raw = nullptr;
+  Py_ssize_t n = 0;
+  if (PyBytes_AsStringAndSize(r, &raw, &n) != 0) {
+    Py_DECREF(r);
+    return fail();
+  }
+  w->raw_out.assign(raw, static_cast<size_t>(n));
+  Py_DECREF(r);
+  *out_len = static_cast<bst_ulong>(w->raw_out.size());
+  *out_dptr = w->raw_out.data();
+  return 0;
+}
+
+XGB_DLL int XGBoosterLoadModelFromBuffer(BoosterHandle handle,
+                                         const void *buf, bst_ulong len) {
+  Gil gil;
+  auto *w = static_cast<BoosterWrap *>(handle);
+  PyObject *b = PyBytes_FromStringAndSize(
+      static_cast<const char *>(buf), static_cast<Py_ssize_t>(len));
+  if (b == nullptr) return fail();
+  PyObject *r = PyObject_CallMethod(w->obj, "load_model", "O", b);
+  Py_DECREF(b);
+  if (r == nullptr) return fail();
+  Py_DECREF(r);
+  return 0;
+}
+
+XGB_DLL int XGBoosterDumpModel(BoosterHandle handle, const char *fmap,
+                               int with_stats, bst_ulong *out_len,
+                               const char ***out_dump_array) {
+  Gil gil;
+  auto *w = static_cast<BoosterWrap *>(handle);
+  PyObject *ws = PyBool_FromLong(with_stats);
+  PyObject *r = (ws == nullptr) ? nullptr : PyObject_CallMethod(
+      w->obj, "get_dump", "sO", fmap == nullptr ? "" : fmap, ws);
+  Py_XDECREF(ws);
+  if (r == nullptr) return fail();
+  Py_ssize_t n = PySequence_Size(r);
+  if (n < 0) {
+    Py_DECREF(r);
+    return fail();
+  }
+  w->dump.clear();
+  w->dump_ptrs.clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *it = PySequence_GetItem(r, i);
+    const char *c = it != nullptr ? PyUnicode_AsUTF8(it) : nullptr;
+    if (c == nullptr) {
+      Py_XDECREF(it);
+      Py_DECREF(r);
+      return fail();
+    }
+    w->dump.emplace_back(c);
+    Py_DECREF(it);
+  }
+  Py_DECREF(r);
+  for (auto &st : w->dump) w->dump_ptrs.push_back(st.c_str());
+  *out_len = static_cast<bst_ulong>(w->dump.size());
+  *out_dump_array = w->dump_ptrs.data();
   return 0;
 }
