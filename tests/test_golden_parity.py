@@ -690,3 +690,133 @@ def test_golden_refresh_updater_stats(tmp_path):
     np.testing.assert_allclose(t.loss_changes[left], 0.0, atol=1e-7)
     np.testing.assert_allclose(t.loss_changes[right], 0.0, atol=1e-7)
     np.testing.assert_allclose(t.sum_hessian[0], 2.12, atol=1e-6)
+
+
+def _construct_dump_fixture_booster(tmp_path):
+    """The reference's ConstructTree (tests/cpp/tree/test_tree_model.cc:226):
+    root [f0<0] default LEFT; node1 [f1<1] default right; node2 [f2<2]
+    default right; four 0-valued leaves. Injected via a crafted model file
+    exactly as the reference builds it by hand."""
+    import json
+
+    import xgboost_tpu as xgb
+
+    model = {
+        "version": [1, 6, 0],
+        "learner": {
+            "attributes": {}, "feature_names": [], "feature_types": [],
+            "gradient_booster": {
+                "model": {
+                    "gbtree_model_param": {"num_trees": "1",
+                                           "size_leaf_vector": "0"},
+                    "tree_info": [0],
+                    "trees": [{
+                        "base_weights": [0.0] * 7,
+                        "categories": [], "categories_nodes": [],
+                        "categories_segments": [], "categories_sizes": [],
+                        "default_left": [1, 0, 0, 0, 0, 0, 0],
+                        "id": 0,
+                        "left_children": [1, 3, 5, -1, -1, -1, -1],
+                        "loss_changes": [7.0, 6.0, 5.0, 0.0, 0.0, 0.0, 0.0],
+                        "parents": [2147483647, 0, 0, 1, 1, 2, 2],
+                        "right_children": [2, 4, 6, -1, -1, -1, -1],
+                        "split_conditions": [0.0, 1.0, 2.0, 0.0, 0.0, 0.0,
+                                             0.0],
+                        "split_indices": [0, 1, 2, 0, 0, 0, 0],
+                        "split_type": [0] * 7,
+                        "sum_hessian": [8.0, 4.0, 4.0, 2.0, 2.0, 2.0, 2.0],
+                        "tree_param": {"num_deleted": "0",
+                                       "num_feature": "3",
+                                       "num_nodes": "7",
+                                       "size_leaf_vector": "0"},
+                    }],
+                },
+                "name": "gbtree",
+            },
+            "learner_model_param": {"base_score": "0", "num_class": "0",
+                                    "num_feature": "3"},
+            "objective": {"name": "reg:squarederror",
+                          "reg_loss_param": {"scale_pos_weight": "1"}},
+        },
+    }
+    path = tmp_path / "dump_fixture.json"
+    path.write_text(json.dumps(model))
+    return xgb.Booster(model_file=str(path))
+
+
+def _fixture_fmap(tmp_path, t0="i"):
+    f = tmp_path / "featmap.txt"
+    f.write_text(f"0 feat_0 {t0}\n1 feat_1 q\n2 feat_2 int\n")
+    return str(f)
+
+
+def test_golden_dump_json(tmp_path):
+    """tests/cpp/tree/test_tree_model.cc:305 DumpJson: 4 leaves, 3
+    split_conditions, fmap names, no cover without stats, children
+    pairs, valid JSON."""
+    import json
+
+    bst = _construct_dump_fixture_booster(tmp_path)
+    s = bst.get_dump(with_stats=True, dump_format="json")[0]
+    assert s.count("leaf") == 4
+    assert s.count("split_condition") == 3
+    j = json.loads(s)  # valid JSON
+    assert len(j["children"]) == 2
+
+    fmap = _fixture_fmap(tmp_path)
+    s = bst.get_dump(fmap=fmap, with_stats=True, dump_format="json")[0]
+    assert '"split": "feat_0"' in s
+    assert '"split": "feat_1"' in s
+    assert '"split": "feat_2"' in s
+    # indicator ('i') nodes carry no split_condition; int nodes print a
+    # ceil'd integer threshold (tree_model.cc:393,445)
+    assert s.count("split_condition") == 2
+    assert '"split_condition": 2,' in s
+    json.loads(s)
+
+    s = bst.get_dump(fmap=fmap, with_stats=False, dump_format="json")[0]
+    assert "cover" not in s and "gain" not in s
+
+
+def test_golden_dump_text(tmp_path):
+    """tests/cpp/tree/test_tree_model.cc:344 DumpText: 4 leaves, 3 gains
+    with stats, [f0<0]/[f1<1]/[f2<2] plain names, [feat_0] (indicator:
+    no threshold), [feat_2<2] (integer threshold), no cover without
+    stats."""
+    bst = _construct_dump_fixture_booster(tmp_path)
+    s = bst.get_dump(with_stats=True, dump_format="text")[0]
+    assert s.count("leaf") == 4
+    assert s.count("gain") == 3
+    assert "[f0<0]" in s and "[f1<1]" in s and "[f2<2]" in s
+
+    fmap = _fixture_fmap(tmp_path)
+    s = bst.get_dump(fmap=fmap, with_stats=True, dump_format="text")[0]
+    assert "[feat_0]" in s  # indicator: name only
+    assert "[feat_1<1]" in s
+    assert "[feat_2<2]" in s
+
+    s = bst.get_dump(fmap=fmap, with_stats=False, dump_format="text")[0]
+    assert "cover" not in s
+
+
+def test_golden_dump_dot(tmp_path):
+    """tests/cpp/tree/test_tree_model.cc:383 DumpDot: 4 leaves, 6 edges,
+    fmap labels, graph_attrs pass-through, yes/no edges with ', missing'
+    on the default child (root defaults LEFT, node 1 defaults RIGHT)."""
+    bst = _construct_dump_fixture_booster(tmp_path)
+    s = bst.get_dump(with_stats=True, dump_format="dot")[0]
+    assert s.count("leaf") == 4
+    assert s.count("->") == 6
+
+    fmap = _fixture_fmap(tmp_path)
+    s = bst.get_dump(fmap=fmap, dump_format="dot")[0]
+    assert '"feat_0"' in s  # indicator label: name only
+    assert "feat_1<1" in s
+    assert "feat_2<2" in s
+
+    s = bst.get_dump(
+        fmap=fmap,
+        dump_format='dot:{"graph_attrs": {"bgcolor": "#FFFF00"}}')[0]
+    assert 'graph [ bgcolor="#FFFF00" ]' in s
+    assert '0 -> 1 [label="yes, missing"' in s  # root defaults left
+    assert '1 -> 4 [label="no, missing"' in s  # node 1 defaults right

@@ -261,7 +261,7 @@ def test_apply_split_partition_counts():
     import json
 
     tree = json.loads(bst.get_dump(dump_format="json")[0])
-    thresh = tree["split_conditions"][0]  # root node, SoA schema layout
+    thresh = tree["split_condition"]  # reference dump schema: root node
     want_left = int((X[:, 0] < thresh).sum())
     assert sorted(counts.tolist()) == sorted([want_left, n - want_left])
     # the split must land within one sketch bin (~n/max_bin rows) of the

@@ -58,9 +58,8 @@ def test_root_matches_approx_depth1():
 
     t_loc = json.loads(b_loc.get_dump(dump_format="json")[0])
     t_apx = json.loads(b_apx.get_dump(dump_format="json")[0])
-    assert t_loc["split_indices"][0] == t_apx["split_indices"][0]
-    assert abs(t_loc["split_conditions"][0]
-               - t_apx["split_conditions"][0]) < 1e-6
+    assert t_loc["split"] == t_apx["split"]
+    assert abs(t_loc["split_condition"] - t_apx["split_condition"]) < 1e-6
 
 
 def test_trains_deep_and_deterministic():

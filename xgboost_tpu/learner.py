@@ -905,30 +905,59 @@ class Booster:
                 list(getattr(self, "_loaded_feature_types", []) or []))
 
     @staticmethod
-    def _parse_fmap(fmap: str) -> Optional[List[str]]:
+    def _parse_fmap_full(fmap: str
+                         ) -> Optional[Tuple[List[str], List[str]]]:
         """featmap.txt parsing ('<id> <name> <type>' per line — reference
-        core.py FeatureMap); None when the file is absent/empty."""
+        core.py FeatureMap); (names, types) or None when absent/empty.
+        Types follow the reference vocabulary: i / q / int / float / c."""
         if not fmap or not os.path.exists(fmap):
             return None
         names: Dict[int, str] = {}
+        types: Dict[int, str] = {}
         with open(fmap) as f:
             for line in f:
                 ps = line.split()
                 if len(ps) >= 2:
                     names[int(ps[0])] = ps[1]
+                    if len(ps) >= 3:
+                        types[int(ps[0])] = ps[2]
         if not names:
             return None
-        return [names.get(i, f"f{i}") for i in range(max(names) + 1)]
+        n = max(names) + 1
+        return ([names.get(i, f"f{i}") for i in range(n)],
+                [types.get(i, "q") for i in range(n)])
+
+    @classmethod
+    def _parse_fmap(cls, fmap: str) -> Optional[List[str]]:
+        parsed = cls._parse_fmap_full(fmap)
+        return parsed[0] if parsed else None
 
     def get_dump(self, fmap: str = "", with_stats: bool = False, dump_format: str = "text") -> List[str]:
+        """Per-tree dump strings in the reference's generator formats
+        (src/tree/tree_model.cc: text :235, json :362 — the per-node
+        nodeid/split/children structure downstream parsers consume — and
+        ``dot``/``dot:{attrs-json}`` :550). featmap types drive the same
+        per-type formatting ('i' indicator, 'int' ceil'd threshold)."""
         self._configure()
-        names = self._parse_fmap(fmap)
+        parsed = self._parse_fmap_full(fmap)
+        names, types = parsed if parsed else (None, None)
+        if not names:
+            meta_names, meta_types = self._feature_meta()
+            names = meta_names or None
+            types = types or (meta_types or None)
         out = []
         for t in self._gbm.model.trees:
             if dump_format == "json":
-                out.append(json.dumps(t.to_json()))
+                out.append(t.dump_json_ref(names, with_stats, types))
+            elif dump_format == "text":
+                out.append(t.dump_text(names, with_stats, types))
+            elif dump_format.startswith("dot"):
+                attrs = None
+                if dump_format.startswith("dot:"):
+                    attrs = json.loads(dump_format[4:])
+                out.append(t.dump_dot(names, types, attrs))
             else:
-                out.append(t.dump_text(names, with_stats))
+                raise ValueError(f"Unknown dump format: {dump_format!r}")
         return out
 
     def dump_model(self, fout, fmap: str = "", with_stats: bool = False, dump_format: str = "text") -> None:

@@ -414,7 +414,33 @@ class RegTree:
             i = self._next(i, x)
         return i
 
-    def dump_text(self, fmap: Optional[List[str]] = None, with_stats: bool = False) -> str:
+    # ---- dump generators: the reference's TreeGenerator family
+    # (src/tree/tree_model.cc:235 Text, :362 Json, :550 Graphviz), with the
+    # same per-feature-TYPE formatting driven by featmap types: 'i'
+    # (indicator: name only, yes = the value-1 child), 'int' (ceil'd
+    # integer threshold), 'q'/'float' (quantitative), categorical nodes by
+    # their stored category set ----
+
+    def _fname(self, i: int, names) -> str:
+        f = int(self.split_indices[i])
+        return names[f] if names and f < len(names) else f"f{f}"
+
+    def _ftype(self, i: int, types) -> str:
+        f = int(self.split_indices[i])
+        return types[f] if types and f < len(types) else "q"
+
+    def _is_cat(self, i: int) -> bool:
+        return (self.split_type is not None
+                and bool(self.split_type[i] == 1))
+
+    def _cats_of(self, i: int) -> List[int]:
+        if self.categories is None:
+            return []
+        return [int(c) for c in self.categories[i]]
+
+    def dump_text(self, fmap: Optional[List[str]] = None,
+                  with_stats: bool = False,
+                  ftypes: Optional[List[str]] = None) -> str:
         lines: List[str] = []
 
         def rec(i: int, depth: int) -> None:
@@ -424,23 +450,154 @@ class RegTree:
                 if with_stats:
                     s += f",cover={self.sum_hessian[i]:.6g}"
                 lines.append(s)
+                return
+            fname = self._fname(i, fmap)
+            ftype = self._ftype(i, ftypes)
+            yes, no = self.left_children[i], self.right_children[i]
+            miss = yes if self.default_left[i] else no
+            if self._is_cat(i):
+                # stored sets go RIGHT: yes=right (tree_model.cc:321)
+                cats = "{" + ",".join(str(c) for c in self._cats_of(i)) + "}"
+                s = (f"{indent}{i}:[{fname}:{cats}] "
+                     f"yes={no},no={yes},missing={miss}")
+            elif ftype == "i":
+                # indicator: name only; yes = the value-1 child, no = the
+                # default child (tree_model.cc:256)
+                nyes = no if self.default_left[i] else yes
+                s = f"{indent}{i}:[{fname}] yes={nyes},no={miss}"
             else:
-                fname = (
-                    fmap[self.split_indices[i]]
-                    if fmap
-                    else f"f{self.split_indices[i]}"
-                )
-                yes, no = self.left_children[i], self.right_children[i]
-                miss = yes if self.default_left[i] else no
-                s = (
-                    f"{indent}{i}:[{fname}<{self.split_conditions[i]:.6g}] "
-                    f"yes={yes},no={no},missing={miss}"
-                )
-                if with_stats:
-                    s += f",gain={self.loss_changes[i]:.6g},cover={self.sum_hessian[i]:.6g}"
-                lines.append(s)
-                rec(yes, depth + 1)
-                rec(no, depth + 1)
+                cond = float(self.split_conditions[i])
+                if ftype == "int":
+                    import math
+
+                    cond_s = str(int(math.ceil(cond)))
+                else:
+                    cond_s = f"{cond:.6g}"
+                s = (f"{indent}{i}:[{fname}<{cond_s}] "
+                     f"yes={yes},no={no},missing={miss}")
+            if with_stats:
+                s += (f",gain={self.loss_changes[i]:.6g}"
+                      f",cover={self.sum_hessian[i]:.6g}")
+            lines.append(s)
+            rec(yes, depth + 1)
+            rec(no, depth + 1)
 
         rec(0, 0)
         return "\n".join(lines)
+
+    def dump_json_ref(self, fmap: Optional[List[str]] = None,
+                      with_stats: bool = False,
+                      ftypes: Optional[List[str]] = None) -> str:
+        """The reference's per-node recursive DUMP-json (tree_model.cc:362
+        JsonGenerator — nodeid/depth/split/split_condition/yes/no/missing/
+        children), which downstream parsers consume; distinct from the
+        model-schema ``to_json``."""
+        import json as _json
+        import math
+
+        def rec(i: int, depth: int) -> str:
+            ind = "  " * (depth + 1)
+            if self.is_leaf(i):
+                s = (f'{{ "nodeid": {i}, '
+                     f'"leaf": {float(self.split_conditions[i]):.6g}')
+                if with_stats:
+                    s += f', "cover": {float(self.sum_hessian[i]):.6g} '
+                return s + "}"
+            fname = self._fname(i, fmap)
+            ftype = self._ftype(i, ftypes)
+            yes, no = int(self.left_children[i]), int(self.right_children[i])
+            miss = yes if self.default_left[i] else no
+            if self._is_cat(i):
+                cats = "[" + ", ".join(
+                    str(c) for c in self._cats_of(i)) + "]"
+                head = (f'{{ "nodeid": {i}, "depth": {depth}, '
+                        f'"split": {_json.dumps(fname)}, '
+                        f'"split_condition": {cats}, "yes": {no}, '
+                        f'"no": {yes}, "missing": {miss}')
+            elif ftype == "i":
+                nyes = no if self.default_left[i] else yes
+                head = (f'{{ "nodeid": {i}, "depth": {depth}, '
+                        f'"split": {_json.dumps(fname)}, '
+                        f'"yes": {nyes}, "no": {miss}')
+            else:
+                cond = float(self.split_conditions[i])
+                cond_s = (str(int(math.ceil(cond))) if ftype == "int"
+                          else f"{cond:.6g}")
+                head = (f'{{ "nodeid": {i}, "depth": {depth}, '
+                        f'"split": {_json.dumps(fname)}, '
+                        f'"split_condition": {cond_s}, "yes": {yes}, '
+                        f'"no": {no}, "missing": {miss}')
+            if with_stats:
+                head += (f', "gain": {float(self.loss_changes[i]):.6g}, '
+                         f'"cover": {float(self.sum_hessian[i]):.6g}')
+            return (head + ', "children": [\n'
+                    + "  " * (depth + 2) + rec(yes, depth + 1) + ",\n"
+                    + "  " * (depth + 2) + rec(no, depth + 1) + "\n"
+                    + ind + "]}")
+
+        return rec(0, 0)
+
+    def dump_dot(self, fmap: Optional[List[str]] = None,
+                 ftypes: Optional[List[str]] = None,
+                 attrs: Optional[dict] = None) -> str:
+        """Graphviz dump (tree_model.cc:550 GraphvizGenerator): node per
+        split ("fname<cond", name only for indicators, "fname:{set}" for
+        categorical), yes/no edges with ", missing" on the default
+        child."""
+        attrs = attrs or {}
+        yes_color = attrs.get("edge", {}).get("yes_color", "#0000FF")
+        no_color = attrs.get("edge", {}).get("no_color", "#FF0000")
+        rankdir = attrs.get("rankdir", "TB")
+        cond_params = " ".join(
+            f'{k}="{v}"' for k, v in
+            attrs.get("condition_node_params", {}).items())
+        leaf_params = " ".join(
+            f'{k}="{v}"' for k, v in
+            attrs.get("leaf_node_params", {}).items())
+        graph_attrs = "".join(
+            f'    graph [ {k}="{v}" ]\n'
+            for k, v in attrs.get("graph_attrs", {}).items())
+
+        out: List[str] = []
+
+        def edge(i: int, child: int, left: bool, is_cat: bool) -> str:
+            miss = (self.left_children[i] if self.default_left[i]
+                    else self.right_children[i])
+            is_missing = child == miss
+            branch = ("no" if left else "yes") if is_cat else \
+                ("yes" if left else "no")
+            if is_missing:
+                branch += ", missing"
+            color = yes_color if is_missing else no_color
+            return (f'    {i} -> {child} [label="{branch}" '
+                    f'color="{color}"]\n')
+
+        def rec(i: int) -> None:
+            if self.is_leaf(i):
+                out.append(
+                    f'    {i} [ label="leaf={self.split_conditions[i]:.6g}"'
+                    f' {leaf_params}]\n')
+                return
+            fname = self._fname(i, fmap)
+            ftype = self._ftype(i, ftypes)
+            yes, no = int(self.left_children[i]), int(self.right_children[i])
+            if self._is_cat(i):
+                cats = "{" + ",".join(str(c) for c in self._cats_of(i)) + "}"
+                out.append(f'    {i} [ label="{fname}:{cats}" '
+                           f'{cond_params}]\n')
+                out.append(edge(i, yes, True, True))
+                out.append(edge(i, no, False, True))
+            else:
+                lab = (fname if ftype == "i"
+                       else f"{fname}<{float(self.split_conditions[i]):.6g}")
+                out.append(f'    {i} [ label="{lab}" {cond_params}]\n')
+                out.append(edge(i, yes, True, False))
+                out.append(edge(i, no, False, False))
+            rec(yes)
+            rec(no)
+
+        rec(0)
+        return ("digraph {\n"
+                f"    graph [ rankdir={rankdir} ]\n"
+                f"{graph_attrs}\n"
+                + "".join(out) + "}")
