@@ -820,3 +820,29 @@ def test_golden_dump_dot(tmp_path):
     assert 'graph [ bgcolor="#FFFF00" ]' in s
     assert '0 -> 1 [label="yes, missing"' in s  # root defaults left
     assert '1 -> 4 [label="no, missing"' in s  # node 1 defaults right
+
+
+def test_dump_basic_contract(tmp_path):
+    """Reference tests/python/test_basic.py::test_dump: the json dump's
+    root is nodeid 0, 'gain' appears with stats, and a nonexistent fmap
+    path raises ValueError."""
+    import json
+
+    import pytest
+
+    import xgboost_tpu as xgb
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(100, 2)
+    y = np.array([0, 1] * 50, np.float32)
+    d = xgb.DMatrix(X, label=y, feature_names=["Feature1", "Feature2"])
+    bst = xgb.train({"objective": "binary:logistic", "max_depth": 1,
+                     "eta": 0.3, "verbosity": 0}, d, 1)
+    dump = bst.get_dump()
+    assert len(dump) == 1
+    j = json.loads(bst.get_dump(dump_format="json")[0])
+    assert j["nodeid"] == 0
+    j = json.loads(bst.get_dump(dump_format="json", with_stats=True)[0])
+    assert "gain" in j
+    with pytest.raises(ValueError):
+        bst.get_dump(fmap="foo")

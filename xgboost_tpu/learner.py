@@ -908,10 +908,14 @@ class Booster:
     def _parse_fmap_full(fmap: str
                          ) -> Optional[Tuple[List[str], List[str]]]:
         """featmap.txt parsing ('<id> <name> <type>' per line — reference
-        core.py FeatureMap); (names, types) or None when absent/empty.
-        Types follow the reference vocabulary: i / q / int / float / c."""
-        if not fmap or not os.path.exists(fmap):
+        core.py FeatureMap); (names, types) or None when no file is given.
+        Types follow the reference vocabulary: i / q / int / float / c.
+        A nonexistent path is an error, matching the reference
+        (tests/python/test_basic.py::test_dump expects ValueError)."""
+        if not fmap:
             return None
+        if not os.path.exists(fmap):
+            raise ValueError(f"No such featmap file: {fmap!r}")
         names: Dict[int, str] = {}
         types: Dict[int, str] = {}
         with open(fmap) as f:
