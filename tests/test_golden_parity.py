@@ -846,3 +846,21 @@ def test_dump_basic_contract(tmp_path):
     assert "gain" in j
     with pytest.raises(ValueError):
         bst.get_dump(fmap="foo")
+
+
+def test_gblinear_dump_format():
+    """gblinear dumps as bias-then-weights (gblinear_model.h:99), text and
+    json — previously an AttributeError."""
+    import json
+
+    import xgboost_tpu as xgb
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(200, 3).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    b = xgb.train({"booster": "gblinear", "objective": "binary:logistic",
+                   "verbosity": 0}, xgb.DMatrix(X, label=y), 3)
+    d = b.get_dump()
+    assert len(d) == 1 and d[0].startswith("bias:") and "weight:" in d[0]
+    j = json.loads(b.get_dump(dump_format="json")[0])
+    assert len(j["bias"]) == 1 and len(j["weight"]) == 3

@@ -949,6 +949,19 @@ class Booster:
             meta_names, meta_types = self._feature_meta()
             names = meta_names or None
             types = types or (meta_types or None)
+        if self._gbm.name == "gblinear":
+            # one dump string: bias then per-feature weights
+            # (gblinear_model.h:99 DumpModel)
+            w = np.asarray(self._gbm.weights)  # [F+1, K], last row = bias
+            bias, wt = w[-1], w[:-1]
+            if dump_format == "json":
+                return [json.dumps(
+                    {"bias": [float(b) for b in bias],
+                     "weight": [float(v) for row in wt for v in row]},
+                    indent=2)]
+            lines = ["bias:"] + [f"{float(b):.6g}" for b in bias] + \
+                ["weight:"] + [f"{float(v):.6g}" for row in wt for v in row]
+            return ["\n".join(lines) + "\n"]
         out = []
         for t in self._gbm.model.trees:
             if dump_format == "json":
