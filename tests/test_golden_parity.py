@@ -864,3 +864,25 @@ def test_gblinear_dump_format():
     assert len(d) == 1 and d[0].startswith("bias:") and "weight:" in d[0]
     j = json.loads(b.get_dump(dump_format="json")[0])
     assert len(j["bias"]) == 1 and len(j["weight"]) == 3
+
+
+def test_gblinear_score_and_dataframe_contracts():
+    """gblinear feature importance: only 'weight' defined, scores are the
+    coefficients (gblinear.cc:240); trees_to_dataframe refuses non-tree
+    boosters like the reference's core.py."""
+    import pytest
+
+    import xgboost_tpu as xgb
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(200, 3).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    b = xgb.train({"booster": "gblinear", "objective": "binary:logistic",
+                   "verbosity": 0}, xgb.DMatrix(X, label=y), 3)
+    s = b.get_score()
+    assert set(s) == {"f0", "f1", "f2"}
+    assert all(np.isfinite(v) for v in s.values())
+    with pytest.raises(ValueError, match="weight"):
+        b.get_score(importance_type="gain")
+    with pytest.raises(ValueError, match="not defined"):
+        b.trees_to_dataframe()

@@ -989,6 +989,23 @@ class Booster:
     def get_score(self, fmap: str = "", importance_type: str = "weight") -> Dict[str, float]:
         """Feature importances (reference: CalcFeatureScore learner.cc)."""
         self._configure()
+        if self._gbm.name == "gblinear":
+            # reference gblinear.cc:240: only 'weight' is defined, and the
+            # scores ARE the per-feature coefficients (bias excluded)
+            if importance_type != "weight":
+                raise ValueError(
+                    "gblinear only has `weight` defined for feature "
+                    "importance")
+            w = np.asarray(self._gbm.weights)[:-1]  # [F, K]
+            names = self._parse_fmap(fmap) or self._feature_meta()[0] or None
+
+            def lname(f: int) -> str:
+                return names[f] if names and f < len(names) else f"f{f}"
+
+            if w.shape[1] == 1:
+                return {lname(f): float(w[f, 0]) for f in range(w.shape[0])}
+            return {f"{lname(f)}_g{g}": float(w[f, g])
+                    for f in range(w.shape[0]) for g in range(w.shape[1])}
         gain: Dict[int, float] = {}
         cover: Dict[int, float] = {}
         weight: Dict[int, float] = {}
@@ -1037,6 +1054,11 @@ class Booster:
     def trees_to_dataframe(self, fmap: str = ""):
         import pandas as pd
 
+        self._configure()
+        if self._gbm.name not in ("gbtree", "dart"):
+            raise ValueError(
+                "This method is not defined for Booster type "
+                f"{self._gbm.name}")
         rows = []
         for ti, t in enumerate(self._gbm.model.trees):
             for i in range(t.num_nodes):
