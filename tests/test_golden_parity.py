@@ -886,3 +886,32 @@ def test_gblinear_score_and_dataframe_contracts():
         b.get_score(importance_type="gain")
     with pytest.raises(ValueError, match="not defined"):
         b.trees_to_dataframe()
+
+
+def test_gblinear_contribs_and_refusals():
+    """gblinear predict surfaces match the reference: contributions are
+    x_f * w_f with bias+base in the last column and sum to the margin
+    (gblinear.cc:176); interactions are all-zero (no interaction effects,
+    :214); pred_leaf and Slice are refused (:172, gbm.h:70)."""
+    import pytest
+
+    import xgboost_tpu as xgb
+
+    rng = np.random.RandomState(3)
+    X = rng.randn(300, 4).astype(np.float32)
+    X[rng.rand(300, 4) < 0.1] = np.nan
+    y = (np.nan_to_num(X[:, 0]) > 0).astype(np.float32)
+    d = xgb.DMatrix(X, label=y)
+    b = xgb.train({"booster": "gblinear", "objective": "binary:logistic",
+                   "verbosity": 0}, d, 5)
+    contribs = b.predict(d, pred_contribs=True)
+    assert contribs.shape == (300, 5)
+    margin = np.asarray(b.predict(d, output_margin=True))
+    np.testing.assert_allclose(contribs.sum(axis=1), margin, rtol=1e-5,
+                               atol=1e-6)
+    inter = b.predict(d, pred_interactions=True)
+    assert inter.shape == (300, 5, 5) and not inter.any()
+    with pytest.raises(ValueError, match="leaf"):
+        b.predict(d, pred_leaf=True)
+    with pytest.raises(ValueError, match="Slice"):
+        b[0:2]

@@ -586,6 +586,21 @@ class Booster:
                 else 1
             )
             iteration_range = (0, max(1, ntree_limit // per_round))
+        if self._gbm.name == "gblinear":
+            if pred_leaf:
+                raise ValueError(
+                    "gblinear does not support prediction of leaf index")
+            if pred_interactions:
+                # linear models have no interaction effects: zeros with
+                # the contribs' shape convention (gblinear.cc:214)
+                n = data.num_row()
+                F = self.num_features()
+                K = max(1, self.n_groups)
+                shape = (n, F + 1, F + 1) if K == 1 else (n, K, F + 1,
+                                                          F + 1)
+                return np.zeros(shape, np.float32)
+            if pred_contribs:
+                return self._gblinear_contribs(data)
         if pred_leaf:
             parts = [np.asarray(self._gbm.predict_leaf(X))
                      for _, _, X in self._data_blocks(data)]
@@ -986,6 +1001,23 @@ class Booster:
                 for i, d in enumerate(dumps):
                     f.write(f"booster[{i}]:\n{d}\n")
 
+    def _gblinear_contribs(self, data: DMatrix) -> np.ndarray:
+        """Per-feature linear contributions (gblinear.cc:176
+        PredictContribution): present entries contribute x_f * w_f
+        (missing contribute 0), and the last column is bias + base
+        margin. [n, F+1], or [n, K, F+1] for multiple output groups."""
+        w = np.asarray(self._gbm.weights)  # [F+1, K]
+        X = np.asarray(data.data, np.float32)
+        n, F = X.shape
+        K = w.shape[1]
+        Xz = np.nan_to_num(X, nan=0.0)
+        base = self._base_margin_val
+        out = np.empty((n, K, F + 1), np.float32)
+        for g in range(K):
+            out[:, g, :F] = Xz * w[None, :F, g].reshape(1, F)
+            out[:, g, F] = w[F, g] + base
+        return out[:, 0, :] if K == 1 else out
+
     def get_score(self, fmap: str = "", importance_type: str = "weight") -> Dict[str, float]:
         """Feature importances (reference: CalcFeatureScore learner.cc)."""
         self._configure()
@@ -1040,6 +1072,11 @@ class Booster:
 
     def __getitem__(self, val) -> "Booster":
         """Layer slicing (reference: Learner::Slice)."""
+        self._configure()
+        if self._gbm.name == "gblinear":
+            # reference gbm.h:70: the base GradientBooster::Slice fails;
+            # only tree boosters implement it
+            raise ValueError("Slice is not supported by current booster.")
         if isinstance(val, int):
             val = slice(val, val + 1)
         start = val.start or 0
