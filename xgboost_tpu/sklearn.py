@@ -53,7 +53,7 @@ class XGBModel:
         num_parallel_tree: Optional[int] = None,
         monotone_constraints: Optional[Union[str, Sequence[int]]] = None,
         interaction_constraints: Optional[Union[str, Sequence[Sequence[int]]]] = None,
-        importance_type: str = "gain",
+        importance_type: Optional[str] = None,
         eval_metric: Optional[Union[str, List[str], Callable]] = None,
         early_stopping_rounds: Optional[int] = None,
         max_bin: Optional[int] = None,
@@ -220,7 +220,11 @@ class XGBModel:
     @property
     def feature_importances_(self) -> np.ndarray:
         b = self.get_booster()
-        score = b.get_score(importance_type=self.importance_type)
+        # reference sklearn.py:1142: default importance is 'weight' for
+        # gblinear (its only defined type) and 'gain' for tree boosters
+        itype = self.importance_type or (
+            "weight" if self.booster == "gblinear" else "gain")
+        score = b.get_score(importance_type=itype)
         n = b.num_features()
         names = [f"f{i}" for i in range(n)]
         stored = None
