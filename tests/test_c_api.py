@@ -324,3 +324,68 @@ def test_c_api_csr_dump_and_buffer_roundtrip(lib, tmp_path):
     _check(lib, lib.XGBoosterFree(bh))
     _check(lib, lib.XGBoosterFree(bh2))
     _check(lib, lib.XGDMatrixFree(h))
+
+
+def test_c_api_predict_from_dmatrix(lib):
+    """The modern JSON-config predict entry (c_api.h:928): value, margin,
+    leaf, and contribs types with explicit shape reporting, matching the
+    Python API bit-for-bit."""
+    X, y = _data(400, 4, seed=9)
+    n, F = X.shape
+    h = ctypes.c_void_p()
+    Xf = np.ascontiguousarray(X)
+    _check(lib, lib.XGDMatrixCreateFromMat(
+        Xf.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), n, F,
+        ctypes.c_float(float("nan")), ctypes.byref(h)))
+    yl = np.ascontiguousarray(y)
+    _check(lib, lib.XGDMatrixSetFloatInfo(
+        h, b"label", yl.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), n))
+    bh = ctypes.c_void_p()
+    mats = (ctypes.c_void_p * 1)(h)
+    _check(lib, lib.XGBoosterCreate(mats, 1, ctypes.byref(bh)))
+    for k, v in [(b"objective", b"binary:logistic"), (b"max_depth", b"3"),
+                 (b"seed", b"2"), (b"verbosity", b"0")]:
+        _check(lib, lib.XGBoosterSetParam(bh, k, v))
+    for it in range(4):
+        _check(lib, lib.XGBoosterUpdateOneIter(bh, it, h))
+
+    lib.XGBoosterPredictFromDMatrix.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_uint64)),
+        ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_float))]
+
+    def run(cfg: bytes):
+        shp = ctypes.POINTER(ctypes.c_uint64)()
+        dim = ctypes.c_uint64()
+        res = ctypes.POINTER(ctypes.c_float)()
+        _check(lib, lib.XGBoosterPredictFromDMatrix(
+            bh, h, cfg, ctypes.byref(shp), ctypes.byref(dim),
+            ctypes.byref(res)))
+        shape = tuple(shp[i] for i in range(dim.value))
+        count = int(np.prod(shape))
+        return np.ctypeslib.as_array(res, shape=(count,)).copy().reshape(
+            shape)
+
+    import xgboost_tpu as xgb
+
+    d = xgb.DMatrix(X, label=y)
+    bst = xgb.train({"objective": "binary:logistic", "max_depth": 3,
+                     "seed": 2, "verbosity": 0}, d, 4)
+    np.testing.assert_array_equal(run(b'{"type": 0}'),
+                                  np.asarray(bst.predict(d), np.float32))
+    np.testing.assert_array_equal(
+        run(b'{"type": 1}'),
+        np.asarray(bst.predict(d, output_margin=True), np.float32))
+    leaf = run(b'{"type": 6}')
+    assert leaf.shape == (n, 4)
+    np.testing.assert_array_equal(
+        leaf, np.asarray(bst.predict(d, pred_leaf=True), np.float32))
+    contribs = run(b'{"type": 2}')
+    assert contribs.shape == (n, F + 1)
+    # iteration_range through the config
+    p2 = run(b'{"type": 0, "iteration_begin": 0, "iteration_end": 2}')
+    np.testing.assert_array_equal(
+        p2, np.asarray(bst.predict(d, iteration_range=(0, 2)), np.float32))
+    _check(lib, lib.XGBoosterFree(bh))
+    _check(lib, lib.XGDMatrixFree(h))

@@ -121,6 +121,7 @@ struct BoosterWrap {
   std::string eval_out;     // XGBoosterEvalOneIter out-string
   std::string attr_out;     // XGBoosterGetAttr out-string
   std::string raw_out;      // XGBoosterSaveModelToBuffer out-bytes
+  std::vector<bst_ulong> pred_shape;  // PredictFromDMatrix out-shape
   std::vector<std::string> dump;      // XGBoosterDumpModel storage
   std::vector<const char *> dump_ptrs;
 };
@@ -680,5 +681,97 @@ XGB_DLL int XGBoosterDumpModel(BoosterHandle handle, const char *fmap,
   for (auto &st : w->dump) w->dump_ptrs.push_back(st.c_str());
   *out_len = static_cast<bst_ulong>(w->dump.size());
   *out_dump_array = w->dump_ptrs.data();
+  return 0;
+}
+
+XGB_DLL int XGBoosterPredictFromDMatrix(BoosterHandle handle,
+                                        DMatrixHandle dmat,
+                                        char const *c_json_config,
+                                        bst_ulong const **out_shape,
+                                        bst_ulong *out_dim,
+                                        float const **out_result) {
+  // the modern predict entry (c_api.h:928): JSON-configured type
+  // (0 value, 1 margin, 2 contribs, 4 interactions, 6 leaf),
+  // iteration_begin/end, strict_shape; shape reported explicitly
+  Gil gil;
+  auto *w = static_cast<BoosterWrap *>(handle);
+  auto *d = static_cast<MatWrap *>(dmat);
+  PyObject *jmod = imp("json");
+  if (jmod == nullptr) return fail();
+  PyObject *cfg = PyObject_CallMethod(
+      jmod, "loads", "s",
+      (c_json_config == nullptr || c_json_config[0] == '\0') ? "{}"
+                                                             : c_json_config);
+  if (cfg == nullptr) return fail();
+  long type = 0, it_begin = 0, it_end = 0, strict = 0;
+  PyObject *v;
+  if ((v = PyDict_GetItemString(cfg, "type"))) type = PyLong_AsLong(v);
+  if ((v = PyDict_GetItemString(cfg, "iteration_begin")))
+    it_begin = PyLong_AsLong(v);
+  if ((v = PyDict_GetItemString(cfg, "iteration_end")))
+    it_end = PyLong_AsLong(v);
+  if ((v = PyDict_GetItemString(cfg, "strict_shape")))
+    strict = PyObject_IsTrue(v);
+  Py_DECREF(cfg);
+  if (type == 3) type = 2;  // approx contribs -> exact
+  if (type == 5) type = 4;  // approx interactions -> exact
+  if (type < 0 || type > 6 || (type != 0 && type != 1 && type != 2 &&
+                               type != 4 && type != 6)) {
+    return fail_msg("XGBoosterPredictFromDMatrix: unsupported type");
+  }
+  PyObject *kw = PyDict_New();
+  PyObject *args = Py_BuildValue("(O)", d->obj);
+  PyObject *meth = PyObject_GetAttrString(w->obj, "predict");
+  if (kw == nullptr || args == nullptr || meth == nullptr) {
+    Py_XDECREF(kw);
+    Py_XDECREF(args);
+    Py_XDECREF(meth);
+    return fail();
+  }
+  auto set_true = [&](const char *k) {
+    PyDict_SetItemString(kw, k, Py_True);
+  };
+  if (type == 1) set_true("output_margin");
+  if (type == 2) set_true("pred_contribs");
+  if (type == 4) set_true("pred_interactions");
+  if (type == 6) set_true("pred_leaf");
+  if (strict) set_true("strict_shape");
+  if (it_end > 0) {
+    PyObject *rng = Py_BuildValue("(ll)", it_begin, it_end);
+    if (rng != nullptr) {
+      PyDict_SetItemString(kw, "iteration_range", rng);
+      Py_DECREF(rng);
+    }
+  }
+  PyObject *r = PyObject_Call(meth, args, kw);
+  Py_DECREF(meth);
+  Py_DECREF(args);
+  Py_DECREF(kw);
+  if (r == nullptr) return fail();
+  // capture the shape before flattening
+  PyObject *shp = PyObject_GetAttrString(r, "shape");
+  if (shp == nullptr) {
+    Py_DECREF(r);
+    return fail();
+  }
+  Py_ssize_t nd = PyTuple_Check(shp) ? PyTuple_Size(shp) : -1;
+  if (nd < 0) {
+    Py_DECREF(shp);
+    Py_DECREF(r);
+    return fail_msg("predict returned a non-array");
+  }
+  w->pred_shape.clear();
+  for (Py_ssize_t i = 0; i < nd; ++i) {
+    PyObject *dim = PyTuple_GetItem(shp, i);
+    w->pred_shape.push_back(
+        static_cast<bst_ulong>(PyLong_AsUnsignedLongLong(dim)));
+  }
+  Py_DECREF(shp);
+  int rc = np_to(r, &w->pred);
+  Py_DECREF(r);
+  if (rc != 0) return rc;
+  *out_shape = w->pred_shape.data();
+  *out_dim = static_cast<bst_ulong>(w->pred_shape.size());
+  *out_result = w->pred.data();
   return 0;
 }
