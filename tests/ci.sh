@@ -1,29 +1,48 @@
 #!/bin/bash
 # CI entry point (reference analog: Jenkinsfile / .github workflows +
-# sanitizer builds, CMakeLists.txt:61-64). Three tiers:
-#   1. standard suite on the virtual 8-device CPU mesh
-#   2. debug_nans pass over the numeric core (the jax analog of
+# sanitizer builds, CMakeLists.txt:61-64). Four tiers:
+#   1. standard suite on the virtual 8-device CPU mesh, with span tracing
+#      live (XGBTPU_TRACE) so the emitter is exercised by every test
+#   2. trace validation: the tier-1 trace must parse as Chrome trace JSON
+#      (catches emitter regressions for free on every run)
+#   3. debug_nans pass over the numeric core (the jax analog of
 #      ASan/UBSan: any NaN produced inside a jitted program raises)
-#   3. x64 parity spot-check (sketch/histogram math stable when jax
+#   4. x64 parity spot-check (sketch/histogram math stable when jax
 #      promotes to float64 — catches accidental precision dependence)
 set -e
 cd "$(dirname "$0")/.."
 export JAX_PLATFORMS=cpu
 unset PALLAS_AXON_POOL_IPS
 
-echo "=== tier 1: full suite (8-device virtual mesh) ==="
+echo "=== tier 1: full suite (8-device virtual mesh, traced) ==="
+TRACE_OUT=$(mktemp /tmp/xgbtpu_ci_trace.XXXXXX.json)
+export XGBTPU_TRACE="$TRACE_OUT"
 # Two pytest processes, split alphabetically: a single process compiling
 # the whole suite's XLA:CPU programs occasionally segfaults inside
 # backend_compile_and_load (LLVM flake under heavy compile volume,
 # observed ~50% of single-process full runs; the crashing test varies and
 # every file passes in isolation). Halving the per-process compile load
 # sidesteps it and isolates any crash.
-python -m pytest tests/test_[a-e]*.py -x -q
-python -m pytest tests/test_[f-z]*.py -x -q
+python -m pytest tests/test_[a-e]*.py -x -q -m 'not slow'
+python -m pytest tests/test_[f-z]*.py -x -q -m 'not slow'
+unset XGBTPU_TRACE
 
-echo "=== tier 2: debug_nans numeric core ==="
+echo "=== tier 2: trace parses as Chrome trace JSON ==="
+# load_trace raises on malformed output; trace-report exits nonzero
+python -m xgboost_tpu trace-report "$TRACE_OUT" > /dev/null
+python - "$TRACE_OUT" <<'EOF'
+import sys
+from xgboost_tpu.observability import load_trace
+events = load_trace(sys.argv[1])
+assert events, "CI trace is empty — emitter regressed"
+assert any(e.get("ph") == "X" for e in events), "no complete spans in trace"
+print(f"trace OK: {len(events)} events")
+EOF
+rm -f "$TRACE_OUT"
+
+echo "=== tier 3: debug_nans numeric core ==="
 JAX_DEBUG_NANS=1 python -m pytest tests/test_basic_train.py tests/test_fidelity.py -x -q
 
-echo "=== tier 3: x64 parity spot-check ==="
+echo "=== tier 4: x64 parity spot-check ==="
 JAX_ENABLE_X64=1 python -m pytest tests/test_quantile.py -x -q
 echo "CI OK"

@@ -167,6 +167,7 @@ def test_bench_probe_runs_with_jax_preimported(monkeypatch, tmp_path):
     assert calls, "probe must run even with jax already imported"
 
 
+@pytest.mark.slow  # real-time watchdog waits dominate (~150s wall)
 def test_bench_watchdog_emits_on_midrun_hang():
     """The round-4 driver failure mode: the process wedges inside a device
     dispatch AFTER completing measurements, and nothing ever prints. The

@@ -389,3 +389,78 @@ def test_c_api_predict_from_dmatrix(lib):
         p2, np.asarray(bst.predict(d, iteration_range=(0, 2)), np.float32))
     _check(lib, lib.XGBoosterFree(bh))
     _check(lib, lib.XGDMatrixFree(h))
+
+
+def test_c_api_set_uint_info_exact_above_2_24(lib):
+    """XGDMatrixSetUIntInfo regression (ISSUE 1 satellite): the uint32
+    payload must survive the boundary EXACTLY — the old float32 detour
+    rounded values >= 2^24 (adjacent qids merged, corrupting group
+    structure)."""
+    X, y = _data(4, 3, seed=5)
+    n, F = X.shape
+    h = ctypes.c_void_p()
+    Xf = np.ascontiguousarray(X)
+    _check(lib, lib.XGDMatrixCreateFromMat(
+        Xf.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), n, F,
+        ctypes.c_float(float("nan")), ctypes.byref(h)))
+    # two ADJACENT huge qids: indistinguishable after a float32 round-trip
+    big = np.uint32(1 << 24)
+    qid = np.ascontiguousarray(
+        np.asarray([big, big, big + 1, big + 1], np.uint32))
+    _check(lib, lib.XGDMatrixSetUIntInfo(
+        h, b"qid", qid.ctypes.data_as(ctypes.POINTER(ctypes.c_uint)), n))
+    out_len = ctypes.c_uint64()
+    out_ptr = ctypes.POINTER(ctypes.c_uint)()
+    _check(lib, lib.XGDMatrixGetUIntInfo(
+        h, b"group_ptr", ctypes.byref(out_len), ctypes.byref(out_ptr)))
+    gp = np.ctypeslib.as_array(out_ptr, shape=(out_len.value,)).copy()
+    # 2 groups of 2 rows each; the float detour collapsed them into one
+    np.testing.assert_array_equal(gp, [0, 2, 4])
+    _check(lib, lib.XGDMatrixFree(h))
+
+
+def test_c_api_predict_ntree_limit_counts_trees(lib):
+    """XGBoosterPredict regression (ISSUE 1 satellite): ntree_limit counts
+    TREES, not rounds — on a multiclass model (num_class trees per round)
+    it must slice whole rounds like Python's ntree_limit, not be passed
+    through as an iteration count."""
+    rng = np.random.RandomState(11)
+    X = rng.randn(300, 4).astype(np.float32)
+    y = rng.randint(0, 3, 300).astype(np.float32)
+    n, F = X.shape
+    h = ctypes.c_void_p()
+    Xf = np.ascontiguousarray(X)
+    _check(lib, lib.XGDMatrixCreateFromMat(
+        Xf.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), n, F,
+        ctypes.c_float(float("nan")), ctypes.byref(h)))
+    yl = np.ascontiguousarray(y)
+    _check(lib, lib.XGDMatrixSetFloatInfo(
+        h, b"label", yl.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), n))
+    bh = ctypes.c_void_p()
+    mats = (ctypes.c_void_p * 1)(h)
+    _check(lib, lib.XGBoosterCreate(mats, 1, ctypes.byref(bh)))
+    params = {"objective": "multi:softprob", "num_class": "3",
+              "max_depth": "3", "seed": "4", "verbosity": "0"}
+    for k, v in params.items():
+        _check(lib, lib.XGBoosterSetParam(bh, k.encode(), v.encode()))
+    for it in range(4):
+        _check(lib, lib.XGBoosterUpdateOneIter(bh, it, h))
+
+    d = xgb.DMatrix(X, label=y)
+    bst = xgb.train({k: (int(v) if v.isdigit() else v)
+                     for k, v in params.items()}, d, 4)
+
+    plen = ctypes.c_uint64()
+    pptr = ctypes.POINTER(ctypes.c_float)()
+    # ntree_limit=6 trees == first 2 rounds of a 3-class model
+    _check(lib, lib.XGBoosterPredict(bh, h, 0, 6, 0, ctypes.byref(plen),
+                                     ctypes.byref(pptr)))
+    pred_c = np.ctypeslib.as_array(pptr, shape=(plen.value,)).copy()
+    pred_py = np.asarray(bst.predict(d, ntree_limit=6), np.float32).ravel()
+    np.testing.assert_array_equal(pred_c, pred_py)
+    np.testing.assert_array_equal(
+        pred_c,
+        np.asarray(bst.predict(d, iteration_range=(0, 2)),
+                   np.float32).ravel())
+    _check(lib, lib.XGBoosterFree(bh))
+    _check(lib, lib.XGDMatrixFree(h))

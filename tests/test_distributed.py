@@ -78,6 +78,7 @@ def test_distributed_sketch_close_to_exact():
         np.testing.assert_allclose(pos_e, pos_a, atol=4096 * 0.02)
 
 
+@pytest.mark.slow
 def test_distributed_full_training_parity():
     """End-to-end: margins after 3 distributed rounds match single-device."""
     import xgboost_tpu as xgb
@@ -111,6 +112,7 @@ def test_distributed_full_training_parity():
     np.testing.assert_allclose(run(False), run(True), rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_train_under_mesh_matches_single_device():
     """THE wiring test: xgb.train() inside mesh_context must reproduce the
     single-device model (reference oracle: distributed==single-process
@@ -157,6 +159,7 @@ def test_train_under_mesh_matches_single_device():
     assert abs(a1 - a2) < 0.01, (a1, a2)
 
 
+@pytest.mark.slow
 def test_train_under_mesh_lossguide():
     import xgboost_tpu as xgb
     from xgboost_tpu.parallel import mesh_context
@@ -177,6 +180,7 @@ def test_train_under_mesh_lossguide():
     )
 
 
+@pytest.mark.slow
 def test_mesh_update_many_scan_matches_per_round():
     """The whole-chunk shard_map scan (distributed_boost_rounds_scan) must
     reproduce mesh per-round training on shared cuts."""
@@ -206,6 +210,7 @@ def test_mesh_update_many_scan_matches_per_round():
     np.testing.assert_allclose(p1, p2, rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_mosaic_kernels_under_shard_map_interpret():
     """The REAL pallas level-kernel bodies (construct AND hoisted) execute
     under shard_map via interpret mode and grow trees matching the XLA

@@ -8,6 +8,7 @@ with row-sharded data parallelism over TPU meshes via ``jax.lax.psum`` in
 place of rabit/NCCL AllReduce.
 """
 
+from . import _compat  # noqa: F401  (pre-0.5 jax shims; must patch first)
 from .config import config_context, get_config, set_config  # noqa: F401
 from .data.dmatrix import DMatrix, QuantileDMatrix, load_row_split  # noqa: F401
 from .utils.timer import profiler_context  # noqa: F401
@@ -40,6 +41,7 @@ def build_info() -> dict:
 from . import callback  # noqa: F401
 from . import collective  # noqa: F401
 from . import collective as rabit  # noqa: F401  (legacy alias)
+from . import observability  # noqa: F401  (span tracing + metrics registry)
 from . import objective  # noqa: F401  (registers objectives)
 from . import metric  # noqa: F401  (registers metrics)
 from .gbm import GBTree, Dart, GBLinear  # noqa: F401
@@ -56,6 +58,7 @@ __all__ = [
     "train",
     "cv",
     "callback",
+    "observability",
     "config_context",
     "set_config",
     "get_config",

@@ -1,0 +1,44 @@
+"""Pre-0.5 jax compatibility shims, installed at package import.
+
+This package is written against the modern surface — ``jax.shard_map``
+with the vma replication checker (``check_vma``) and ``jax.lax.pcast``
+varying-axis annotations. Older jax (< 0.5) ships shard_map under
+``jax.experimental`` with the pre-vma ``check_rep`` checker, which cannot
+type this package's level loops (scan carries whose replication the
+histogram psum restores each level), and has no ``pcast`` at all.
+
+The shims patch the ``jax`` namespace so the ~10 call sites across
+``parallel/`` and ``tree/`` stay written in the one modern dialect:
+
+- ``jax.shard_map`` -> the experimental shard_map with replication
+  checking OFF (the compiled program is identical; only the static
+  verifier differs),
+- ``jax.lax.pcast`` -> identity (pcast only adjusts a value's
+  varying-manual-axes TYPE; the pre-vma checker needs no annotation).
+
+Imported from ``xgboost_tpu/__init__`` (and defensively from
+``parallel.mesh``) so the patch is in place before any grower can run —
+no import-ordering dependency on which submodule loads first. On modern
+jax this module is a no-op. The namespace patch is process-global by
+design: this repo is the application, and the alternative (threading a
+local wrapper through every grower) would fork the call sites into two
+dialects.
+"""
+
+from __future__ import annotations
+
+import jax
+
+if not hasattr(jax, "shard_map"):  # pragma: no cover - version-dependent
+    from jax.experimental.shard_map import shard_map as _shard_map_legacy
+
+    def _shard_map_compat(f, *, mesh, in_specs, out_specs, check_vma=None,
+                          **kw):
+        kw.setdefault("check_rep", False)
+        return _shard_map_legacy(f, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, **kw)
+
+    jax.shard_map = _shard_map_compat
+
+if not hasattr(jax.lax, "pcast"):  # pragma: no cover - version-dependent
+    jax.lax.pcast = lambda x, axis_name, to=None: x

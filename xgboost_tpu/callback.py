@@ -21,6 +21,7 @@ __all__ = [
     "EarlyStopping",
     "EvaluationMonitor",
     "TrainingCheckPoint",
+    "TrainingTelemetry",
 ]
 
 _EvalsLog = Dict[str, Dict[str, List[float]]]
@@ -203,6 +204,85 @@ class EvaluationMonitor(TrainingCallback):
         if self._latest is not None:
             print(self._latest, flush=True)
         return model
+
+
+class TrainingTelemetry(TrainingCallback):
+    """Record per-round training telemetry into the metrics registry
+    (``observability.REGISTRY`` unless one is passed) — ISSUE 1 tentpole
+    piece 4. Per round:
+
+    - ``round_seconds`` (histogram): wall time of update + eval;
+    - ``trees_total`` (gauge): trees committed to the model so far;
+    - ``tree_depth`` / ``tree_leaves`` (gauges): shape of the round's last
+      tree (materializes it host-side — that is this callback's cost, and
+      why the recording is opt-in rather than built into ``train()``);
+    - ``split_gain`` (histogram): loss_change of every split in the
+      round's last tree;
+    - ``eval_score{data=,metric=}`` (gauges): latest eval history values;
+
+    plus a ``round`` instant event on the active trace. Telemetry must
+    never break training: model-introspection failures (e.g. gblinear has
+    no trees) are swallowed."""
+
+    def __init__(self, registry=None):
+        from .observability import REGISTRY
+
+        self.registry = registry if registry is not None else REGISTRY
+        self._t0: Optional[float] = None
+
+    def before_iteration(self, model, epoch: int, evals_log) -> bool:
+        import time
+
+        self._t0 = time.perf_counter()
+        return False
+
+    def _record_tree_stats(self, model) -> None:
+        gbm = getattr(model, "_gbm", None)
+        trees = getattr(getattr(gbm, "model", None), "trees", None)
+        if not trees:
+            return
+        reg = self.registry
+        reg.gauge("trees_total", "Trees committed to the model").set(
+            gbm.model.num_trees)
+        last = trees[-1]
+        reg.gauge("tree_depth", "Depth of the last committed tree").set(
+            last.max_depth())
+        reg.gauge("tree_leaves", "Leaves of the last committed tree").set(
+            last.num_leaves)
+        gain = reg.histogram(
+            "split_gain", "Loss change of committed splits",
+            buckets=(0.1, 0.5, 1.0, 5.0, 10.0, 50.0, 100.0, 500.0, 1000.0,
+                     10000.0))
+        internal = last.left_children != -1
+        for g in np.asarray(last.loss_changes)[internal]:
+            gain.observe(float(g))
+
+    def after_iteration(self, model, epoch: int, evals_log) -> bool:
+        import time
+
+        from .observability import trace
+
+        reg = self.registry
+        if self._t0 is not None:
+            reg.histogram(
+                "round_seconds", "Wall time per boosting round",
+            ).observe(time.perf_counter() - self._t0)
+            self._t0 = None
+        try:
+            self._record_tree_stats(model)
+        except Exception:  # introspection must never fail training
+            pass
+        for dname, metrics in (evals_log or {}).items():
+            for mname, vals in metrics.items():
+                if vals:
+                    v = vals[-1]
+                    if isinstance(v, tuple):  # cv: (mean, std)
+                        v = v[0]
+                    reg.gauge(
+                        "eval_score", "Latest eval metric value",
+                    ).labels(data=dname, metric=mname).set(float(v))
+        trace.instant("round", epoch=epoch)
+        return False
 
 
 class TrainingCheckPoint(TrainingCallback):

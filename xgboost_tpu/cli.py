@@ -1,13 +1,16 @@
-"""Config-file driven CLI: train | dump | pred.
+"""Config-file driven CLI: train | dump | pred, plus telemetry tools.
 
 Reference: ``src/cli_main.cc`` (CLITask :30-35, CLIParam :37) + the
 key=value config parser (``src/common/config.h``). Usage:
 
     python -m xgboost_tpu <config> [key=value ...]
+    python -m xgboost_tpu trace-report <trace-file> [--top N]
 
 Config keys mirror the reference: task, data, test:data, model_in,
 model_out, model_dir, num_round, save_period, eval[name]=path, dump_format,
-name_pred, plus any booster/learner parameters.
+name_pred, plus any booster/learner parameters. ``trace-report``
+summarizes a Chrome trace-event file written via ``XGBTPU_TRACE`` (top
+spans by self time, per-rank totals — ``docs/observability.md``).
 """
 
 from __future__ import annotations
@@ -65,6 +68,10 @@ def cli_main(argv: List[str]) -> int:
     if not argv:
         print(__doc__, file=sys.stderr)
         return 1
+    if argv[0] == "trace-report":
+        from .observability.report import main as report_main
+
+        return report_main(argv[1:])
     pairs = parse_config_file(argv[0])
     for extra in argv[1:]:
         k, _, v = extra.partition("=")

@@ -72,7 +72,12 @@ def allreduce(data: np.ndarray, op: int = Op.SUM) -> np.ndarray:
         return arr
     from jax.experimental import multihost_utils
 
-    gathered = np.asarray(multihost_utils.process_allgather(arr))  # [P,...]
+    from .observability import comms, trace
+
+    with trace.span("allreduce", bytes=int(arr.nbytes), op=int(op)):
+        gathered = np.asarray(
+            multihost_utils.process_allgather(arr))  # [P,...]
+    comms.record("allreduce", int(arr.nbytes))
     red = {Op.SUM: np.sum, Op.MAX: np.max, Op.MIN: np.min}[Op(op)]
     return red(gathered, axis=0)
 
@@ -89,14 +94,19 @@ def broadcast(data, root: int):
 
     from jax.experimental import multihost_utils
 
+    from .observability import comms, trace
+
     payload = np.frombuffer(pickle.dumps(data), dtype=np.uint8)
-    # Fixed-size buffer: allgather needs equal shapes across processes.
-    sizes = multihost_utils.process_allgather(
-        np.asarray([payload.size], np.int64))
-    cap = int(np.max(sizes))
-    buf = np.zeros(cap, np.uint8)
-    buf[: payload.size] = payload
-    gathered = np.asarray(multihost_utils.process_allgather(buf))  # [P,cap]
+    with trace.span("broadcast", bytes=int(payload.size), root=root):
+        # Fixed-size buffer: allgather needs equal shapes across processes.
+        sizes = multihost_utils.process_allgather(
+            np.asarray([payload.size], np.int64))
+        cap = int(np.max(sizes))
+        buf = np.zeros(cap, np.uint8)
+        buf[: payload.size] = payload
+        gathered = np.asarray(
+            multihost_utils.process_allgather(buf))  # [P,cap]
+    comms.record("broadcast", cap + 8, n_ops=2)
     root_size = int(np.asarray(sizes).ravel()[root])
     return pickle.loads(gathered[root, :root_size].tobytes())
 

@@ -19,6 +19,9 @@ _DEFAULTS: Dict[str, Any] = {
     # deterministic fixed-point histogram accumulation
     # (gpu_hist/histogram.cu:81-120 rounding trick)
     "deterministic_histogram": True,
+    # span-trace destination (Chrome trace-event JSONL); the XGBTPU_TRACE
+    # env var takes precedence — see observability/trace.py
+    "trace_path": None,
 }
 
 _local = threading.local()

@@ -127,14 +127,18 @@ def compute_cuts(
     category code ``c`` lands in bin ``c`` — one bin per category, the same
     one-bin-per-category layout the reference builds for categorical data
     (``hist_util.cc`` AddCutPoint categorical path)."""
+    from ..observability import trace
+
     X = jnp.asarray(X, dtype=jnp.float32)
     if weights is None or (hasattr(weights, "size") and weights.size == 0):
         weights = jnp.ones((X.shape[0],), dtype=jnp.float32)
     else:
         weights = jnp.asarray(weights, dtype=jnp.float32)
-    values, min_vals = _cuts_kernel(X, weights, max_bin)
-    values = np.array(values)
-    min_vals = np.array(min_vals)
+    with trace.span("sketch", rows=int(X.shape[0]), features=int(X.shape[1]),
+                    max_bin=max_bin):
+        values, min_vals = _cuts_kernel(X, weights, max_bin)
+        values = np.array(values)
+        min_vals = np.array(min_vals)
     if categorical:
         apply_categorical_identity(values, min_vals, categorical)
     return HistogramCuts(values=values, min_vals=min_vals)
@@ -223,9 +227,13 @@ def storage_dtype(max_bin: int):
 def bin_matrix(X: np.ndarray | jax.Array, cuts: HistogramCuts) -> jax.Array:
     """Quantize a dense matrix against cuts. Analog of
     ``GHistIndexMatrix::Init`` / ELLPACK packing (``gradient_index.cc:199``)."""
-    Xj = jnp.asarray(X, dtype=jnp.float32)
-    bins = _bin_kernel(Xj, jnp.asarray(cuts.values))
-    return bins.astype(storage_dtype(cuts.max_bin))
+    from ..observability import trace
+
+    with trace.span("quantize", rows=int(np.shape(X)[0]),
+                    max_bin=cuts.max_bin):
+        Xj = jnp.asarray(X, dtype=jnp.float32)
+        bins = _bin_kernel(Xj, jnp.asarray(cuts.values))
+        return bins.astype(storage_dtype(cuts.max_bin))
 
 
 @dataclasses.dataclass
@@ -407,6 +415,11 @@ class BinnedMatrix:
         recompile when free memory drifts across a feature boundary."""
         from ..tree.hist_kernel import hoist_plan_synced
 
+        if self._onehot_failed:
+            # the latch means the expansion cannot exist on this runtime:
+            # a nonzero plan here would send the chunk scans back to the
+            # failed hoisted build every round (ADVICE r5)
+            return 0
         if (self._hoist_plan_mesh is not None
                 and self._hoist_plan_mesh[0] == id(mesh)):
             return self._hoist_plan_mesh[1]

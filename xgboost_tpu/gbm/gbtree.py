@@ -11,12 +11,14 @@ from __future__ import annotations
 
 import dataclasses as _dc
 import functools
+import time as _time
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..observability import REGISTRY as _REGISTRY, trace as _trace
 from ..params import GBTreeParam, TrainParam
 from ..predictor import StackedForest, predict_leaf, predict_margin, stack_forest
 from ..registry import BOOSTERS
@@ -25,6 +27,13 @@ from ..tree.grow_fused import GrownTree, grow_tree_fused
 from ..tree.model import RegTree
 from ..tree.param import SplitParams
 from ..utils import console_logger
+
+
+def _hist_seconds():
+    return _REGISTRY.histogram(
+        "hist_build_seconds",
+        "Host-side wall time of one tree build dispatch "
+        "(hist + split + partition)")
 
 
 class _PendingTree:
@@ -1109,14 +1118,20 @@ class GBTree:
                         grow_tree_lossguide,
                     )
 
-                    if use_mesh:
-                        alloc = distributed_grow_tree_lossguide(
-                            mesh, bins_sh, g, h, cut_vals, key, cfg, max_leaves, fw
-                        )
-                    else:
-                        alloc = grow_tree_lossguide(
-                            binned.bins, g, h, cut_vals, key, cfg, max_leaves, fw
-                        )
+                    t0 = _time.perf_counter()
+                    with _trace.span("build_tree", iteration=iteration,
+                                     group=k, policy="lossguide"):
+                        if use_mesh:
+                            alloc = distributed_grow_tree_lossguide(
+                                mesh, bins_sh, g, h, cut_vals, key, cfg,
+                                max_leaves, fw
+                            )
+                        else:
+                            alloc = grow_tree_lossguide(
+                                binned.bins, g, h, cut_vals, key, cfg,
+                                max_leaves, fw
+                            )
+                    _hist_seconds().observe(_time.perf_counter() - t0)
                     # on-device prune/leaf-values/delta: the lossguide round
                     # performs zero host syncs, like the fused depthwise path
                     keep, lv, delta_full = finalize_alloc(
@@ -1137,12 +1152,17 @@ class GBTree:
                             margin_cache = margin_cache + delta
                     continue
                 else:
-                    if use_mesh:
-                        heap = distributed_grow_tree(
-                            mesh, bins_sh, g, h, cut_vals, key, cfg, fw
-                        )
-                    else:
-                        heap = grow_tree(binned.bins, g, h, cut_vals, key, cfg, fw)
+                    t0 = _time.perf_counter()
+                    with _trace.span("build_tree", iteration=iteration,
+                                     group=k):
+                        if use_mesh:
+                            heap = distributed_grow_tree(
+                                mesh, bins_sh, g, h, cut_vals, key, cfg, fw
+                            )
+                        else:
+                            heap = grow_tree(binned.bins, g, h, cut_vals,
+                                             key, cfg, fw)
+                    _hist_seconds().observe(_time.perf_counter() - t0)
                     is_split = np.asarray(heap.is_split)
                     loss_chg = np.asarray(heap.loss_chg)
                     pruned = prune_heap(is_split, loss_chg, tp.gamma)
@@ -1390,6 +1410,7 @@ class GBTree:
                 )
 
         new_trees = []
+        hist_seconds = _hist_seconds()
         for k in range(self.n_groups):
             g = grad[:, k] if grad.ndim == 2 else grad
             h = hess[:, k] if hess.ndim == 2 else hess
@@ -1397,7 +1418,11 @@ class GBTree:
                 key = jax.random.PRNGKey(
                     round_seed_py(tp.seed, iteration, k, ptree)
                 )
-                grown = grow_one(g, h, key)
+                t0 = _time.perf_counter()
+                with _trace.span("build_tree", iteration=iteration, group=k,
+                                 ptree=ptree):
+                    grown = grow_one(g, h, key)
+                hist_seconds.observe(_time.perf_counter() - t0)
                 self.model.add_device(grown, tp.eta, k, tp.max_depth,
                                       cat_mask)
                 new_trees.append(grown)
@@ -1448,6 +1473,29 @@ class GBTree:
         exactly; results match the per-round path to float-fusion noise.
         Under an active mesh the whole chunk runs inside one shard_map
         (distributed_boost_rounds_scan)."""
+        t0 = _time.perf_counter()
+        with _trace.span("scan_chunk", start=start_iteration,
+                         rounds=num_rounds):
+            out = self._boost_rounds_scan_impl(
+                binned, obj, label, weight, margin, start_iteration,
+                num_rounds, feature_weights)
+        _REGISTRY.histogram(
+            "scan_chunk_seconds",
+            "Host-side wall time of one fused multi-round scan dispatch",
+        ).observe(_time.perf_counter() - t0)
+        return out
+
+    def _boost_rounds_scan_impl(
+        self,
+        binned,
+        obj,
+        label: jax.Array,
+        weight,
+        margin: jax.Array,
+        start_iteration: int,
+        num_rounds: int,
+        feature_weights=None,
+    ) -> jax.Array:
         from ..parallel.mesh import current_mesh, shard_rows
 
         tp = self.train_param

@@ -25,6 +25,7 @@ from .data.dmatrix import DMatrix
 from .gbm import create_booster
 from .metric import create_metric
 from .objective import create_objective
+from .observability import REGISTRY as _REGISTRY, trace as _trace
 from .params import LearnerParam
 from .registry import BOOSTERS, OBJECTIVES
 from .utils import Monitor, console_logger, fault
@@ -182,6 +183,12 @@ class Booster:
             # local training and takes the normal path.
             self.update_many(dtrain, iteration, 1, chunk=1)
             return
+        with _trace.span("update", iteration=iteration):
+            self._update(dtrain, iteration, fobj)
+        _REGISTRY.counter(
+            "rounds_total", "Boosting rounds dispatched").inc()
+
+    def _update(self, dtrain: DMatrix, iteration: int, fobj=None) -> None:
         fault.begin_version(iteration)
         fault.inject("gradient")
         if fobj is not None:
@@ -264,6 +271,8 @@ class Booster:
             )
             entry.margin = margin
             entry.num_trees = self._gbm.model.num_trees
+            _REGISTRY.counter(
+                "rounds_total", "Boosting rounds dispatched").inc(k)
             done += k
 
     def boost(self, dtrain: DMatrix, grad, hess) -> None:
@@ -392,6 +401,11 @@ class Booster:
     def eval_set(self, evals, iteration: int = 0, feval=None, output_margin: bool = True) -> str:
         self._configure()
         fault.inject("eval")
+        evals = list(evals)
+        with _trace.span("eval", iteration=iteration, n_sets=len(evals)):
+            return self._eval_set(evals, iteration, feval)
+
+    def _eval_set(self, evals, iteration: int, feval=None) -> str:
         parts = [f"[{iteration}]"]
         for dmat, name in evals:
             margin = self._predict_margin(dmat)
@@ -565,6 +579,26 @@ class Booster:
         return margin
 
     def predict(
+        self,
+        data: DMatrix,
+        output_margin: bool = False,
+        pred_leaf: bool = False,
+        pred_contribs: bool = False,
+        approx_contribs: bool = False,
+        pred_interactions: bool = False,
+        validate_features: bool = True,
+        training: bool = False,
+        iteration_range: Optional[Tuple[int, int]] = None,
+        strict_shape: bool = False,
+        ntree_limit: int = 0,
+    ) -> np.ndarray:
+        with _trace.span("predict", rows=data.num_row()):
+            return self._predict(
+                data, output_margin, pred_leaf, pred_contribs,
+                approx_contribs, pred_interactions, validate_features,
+                training, iteration_range, strict_shape, ntree_limit)
+
+    def _predict(
         self,
         data: DMatrix,
         output_margin: bool = False,

@@ -27,7 +27,9 @@ class MultiError(Metric):
         else:
             s, w = float(wrong.sum()), float(wrong.shape[0])
         s, w = dist_reduce(s, w)
-        return s / w if w > 0 else float("nan")
+        # zero reduced weight returns the residue (0.0), not NaN — the
+        # reference's GetFinal convention (multiclass_metric.cu)
+        return s / w if w > 0 else s
 
 
 @METRICS.register("mlogloss")
@@ -44,4 +46,6 @@ class MultiLogLoss(Metric):
         else:
             s, w = float(l.sum()), float(l.shape[0])
         s, w = dist_reduce(s, w)
-        return s / w if w > 0 else float("nan")
+        # zero reduced weight returns the residue (0.0), not NaN — the
+        # reference's GetFinal convention (multiclass_metric.cu)
+        return s / w if w > 0 else s

@@ -113,6 +113,7 @@ PyObject *imp(const char *name) { return PyImport_ImportModule(name); }
 struct MatWrap {
   PyObject *obj;  // xgboost_tpu.DMatrix
   std::vector<float> finfo;  // GetFloatInfo out-buffer
+  std::vector<unsigned> uinfo;  // GetUIntInfo out-buffer
 };
 
 struct BoosterWrap {
@@ -158,6 +159,23 @@ PyObject *np_from(const float *data, bst_ulong n, bst_ulong rows = 0,
     return shaped;
   }
   return copy;
+}
+
+// unsigned buffer -> numpy int64 array (copy). Reading the uint32 payload
+// directly keeps values >= 2^24 exact — a float32 detour would round them
+PyObject *np_from_uint(const unsigned *data, bst_ulong n) {
+  PyObject *np = imp("numpy");
+  if (np == nullptr) return nullptr;
+  PyObject *mv = PyMemoryView_FromMemory(
+      reinterpret_cast<char *>(const_cast<unsigned *>(data)),
+      static_cast<Py_ssize_t>(n * sizeof(unsigned)), PyBUF_READ);
+  if (mv == nullptr) return nullptr;
+  PyObject *r = PyObject_CallMethod(np, "frombuffer", "Os", mv, "uint32");
+  Py_DECREF(mv);
+  if (r == nullptr) return nullptr;
+  PyObject *i64 = PyObject_CallMethod(r, "astype", "s", "int64");  // copy
+  Py_DECREF(r);
+  return i64;
 }
 
 // DMatrix.set_info is keyword-only: call set_info(**{field: value})
@@ -282,14 +300,10 @@ XGB_DLL int XGDMatrixSetUIntInfo(DMatrixHandle handle, const char *field,
                                  const unsigned *data, bst_ulong len) {
   Gil gil;
   auto *w = static_cast<MatWrap *>(handle);
-  std::vector<float> f(data, data + len);
-  PyObject *arr = np_from(f.data(), len);
-  if (arr == nullptr) return fail();
-  PyObject *i32 = PyObject_CallMethod(arr, "astype", "s", "int64");
-  Py_DECREF(arr);
-  if (i32 == nullptr) return fail();
-  int rc = set_info_kw(w->obj, field, i32);
-  Py_DECREF(i32);
+  PyObject *i64 = np_from_uint(data, len);
+  if (i64 == nullptr) return fail();
+  int rc = set_info_kw(w->obj, field, i64);
+  Py_DECREF(i64);
   return rc;
 }
 
@@ -305,6 +319,34 @@ XGB_DLL int XGDMatrixGetFloatInfo(DMatrixHandle handle, const char *field,
   if (rc != 0) return rc;
   *out_len = static_cast<bst_ulong>(w->finfo.size());
   *out_dptr = w->finfo.data();
+  return 0;
+}
+
+XGB_DLL int XGDMatrixGetUIntInfo(DMatrixHandle handle, const char *field,
+                                 bst_ulong *out_len,
+                                 const unsigned **out_dptr) {
+  Gil gil;
+  auto *w = static_cast<MatWrap *>(handle);
+  PyObject *r = PyObject_CallMethod(w->obj, "get_uint_info", "s", field);
+  if (r == nullptr) return fail();
+  PyObject *np = imp("numpy");
+  PyObject *flat = np == nullptr ? nullptr : PyObject_CallMethod(
+      np, "ascontiguousarray", "Os", r, "uint32");
+  Py_DECREF(r);
+  if (flat == nullptr) return fail();
+  PyObject *bytes = PyObject_CallMethod(flat, "tobytes", nullptr);
+  Py_DECREF(flat);
+  Py_ssize_t nb = 0;
+  char *raw = nullptr;
+  if (bytes == nullptr || PyBytes_AsStringAndSize(bytes, &raw, &nb) != 0) {
+    Py_XDECREF(bytes);
+    return fail();
+  }
+  w->uinfo.resize(static_cast<size_t>(nb) / sizeof(unsigned));
+  std::memcpy(w->uinfo.data(), raw, static_cast<size_t>(nb));
+  Py_DECREF(bytes);
+  *out_len = static_cast<bst_ulong>(w->uinfo.size());
+  *out_dptr = w->uinfo.data();
   return 0;
 }
 
@@ -512,11 +554,15 @@ XGB_DLL int XGBoosterPredict(BoosterHandle handle, DMatrixHandle dmat,
   if (!bad) {
     PyDict_SetItemString(kw, "output_margin", om);
     if (ntree_limit > 0) {
-      PyObject *rng = Py_BuildValue("(ii)", 0,
-                                    static_cast<int>(ntree_limit));
-      if (rng != nullptr) {
-        PyDict_SetItemString(kw, "iteration_range", rng);
-        Py_DECREF(rng);
+      // ntree_limit counts TREES, not rounds: forward it verbatim and let
+      // Booster.predict divide by trees-per-round (groups x parallel
+      // trees) — mapping it to iteration_range here would over-slice
+      // multiclass / random-forest models (reference c_api.cc keeps the
+      // same tree-count semantics)
+      PyObject *ntl = PyLong_FromUnsignedLong(ntree_limit);
+      if (ntl != nullptr) {
+        PyDict_SetItemString(kw, "ntree_limit", ntl);
+        Py_DECREF(ntl);
       }
     }
   }

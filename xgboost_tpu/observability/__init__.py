@@ -1,0 +1,38 @@
+"""Unified telemetry: span tracing, metrics registry, comms accounting.
+
+The one observability layer for the training stack (ISSUE 1), replacing
+the reference's three disconnected tools (``common::Monitor`` wall-clock
+accumulators, NVTX ranges, ``TrainingObserver`` dumps):
+
+- ``trace`` — ``span("hist_build", node=k)`` context managers emitting a
+  Chrome trace-event timeline (Perfetto / ``chrome://tracing``), enabled
+  by ``XGBTPU_TRACE=<path>`` or ``set_config(trace_path=...)``;
+- ``metrics`` — the process-wide ``REGISTRY`` of counters / gauges /
+  histograms with Prometheus text exposition and JSON snapshots
+  (``utils.timer.Monitor`` feeds it as a thin adapter);
+- ``comms`` — collective ops/bytes accounting for ``collective.py`` and
+  the mesh psum / all_gather paths;
+- ``report`` — the ``python -m xgboost_tpu trace-report`` summarizer.
+
+Everything is a no-op costing one branch per call site when disabled, and
+never records from inside ``jit``-traced code (host-side only).
+"""
+
+from . import comms, metrics, trace  # noqa: F401
+from .metrics import REGISTRY, MetricsRegistry, get_registry  # noqa: F401
+from .trace import (  # noqa: F401
+    emit,
+    enabled,
+    flush,
+    instant,
+    load_trace,
+    span,
+    trace_path,
+)
+
+__all__ = [
+    "trace", "metrics", "comms",
+    "span", "instant", "emit", "enabled", "flush", "trace_path",
+    "load_trace",
+    "REGISTRY", "MetricsRegistry", "get_registry",
+]

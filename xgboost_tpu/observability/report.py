@@ -1,0 +1,147 @@
+"""Trace summarization: ``python -m xgboost_tpu trace-report <file>``.
+
+Reads a Chrome trace-event file written by ``observability.trace`` (any of
+the accepted forms — see ``load_trace``) and prints:
+
+- per-span-name totals: call count, total (inclusive) time, **self time**
+  (inclusive minus time spent in nested spans on the same rank+thread),
+  ranked by self time — "where did this round's milliseconds go";
+- per-rank (Chrome ``pid``) totals — "on which host";
+- counts of instant events.
+
+Self time is reconstructed per (pid, tid) track with a stack sweep over
+the complete ('X') events sorted by start time: an event strictly
+contained in the open event above it is a child, and its duration is
+subtracted from the parent's self time.
+"""
+
+from __future__ import annotations
+
+import sys
+from collections import defaultdict
+from typing import Any, Dict, Iterable, List, Tuple
+
+from .trace import load_trace
+
+__all__ = ["summarize", "format_report", "main"]
+
+
+def _self_times(events: List[Dict[str, Any]]) -> Dict[str, float]:
+    """name -> self time (us), via a per-track stack sweep."""
+    tracks: Dict[Tuple[Any, Any], List[Dict[str, Any]]] = defaultdict(list)
+    for ev in events:
+        tracks[(ev.get("pid", 0), ev.get("tid", 0))].append(ev)
+    self_us: Dict[str, float] = defaultdict(float)
+
+    def close(frame: List[Any]) -> None:
+        ts, end, name, child_dur = frame
+        self_us[name] += max(end - ts - child_dur, 0.0)
+
+    for evs in tracks.values():
+        evs.sort(key=lambda e: (e["ts"], -e.get("dur", 0)))
+        stack: List[List[Any]] = []  # [ts, end, name, child_dur]
+        for ev in evs:
+            ts, dur = ev["ts"], ev.get("dur", 0)
+            # pop every open frame that closed before this event starts
+            while stack and ts >= stack[-1][1]:
+                close(stack.pop())
+            if stack:  # nested: charge our duration to the parent
+                stack[-1][3] += dur
+            stack.append([ts, ts + dur, ev["name"], 0.0])
+        while stack:
+            close(stack.pop())
+    return dict(self_us)
+
+
+def summarize(events: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
+    events = list(events)
+    complete = [e for e in events
+                if e.get("ph") == "X" and "ts" in e and "dur" in e]
+    instants = [e for e in events if e.get("ph") == "i"]
+    per_name: Dict[str, Dict[str, float]] = {}
+    per_rank: Dict[int, Dict[str, float]] = {}
+    for ev in complete:
+        s = per_name.setdefault(ev["name"], {"count": 0, "total_us": 0.0})
+        s["count"] += 1
+        s["total_us"] += ev["dur"]
+        r = per_rank.setdefault(int(ev.get("pid", 0)),
+                                {"count": 0, "total_us": 0.0})
+        r["count"] += 1
+        r["total_us"] += ev["dur"]
+    for name, su in _self_times(complete).items():
+        per_name.setdefault(name, {"count": 0, "total_us": 0.0})[
+            "self_us"] = su
+    for s in per_name.values():
+        s.setdefault("self_us", 0.0)
+    inst_counts: Dict[str, int] = defaultdict(int)
+    for ev in instants:
+        inst_counts[ev["name"]] += 1
+    return {
+        "n_events": len(events),
+        "n_spans": len(complete),
+        "spans": per_name,
+        "ranks": per_rank,
+        "instants": dict(inst_counts),
+    }
+
+
+def _ms(us: float) -> str:
+    return f"{us / 1000.0:.3f}ms"
+
+
+def format_report(summary: Dict[str, Any], top: int = 20) -> str:
+    lines = [
+        f"trace: {summary['n_events']} events, "
+        f"{summary['n_spans']} spans, {len(summary['ranks'])} rank(s)",
+        "",
+        f"top spans by self time (top {top}):",
+        f"  {'name':<28} {'count':>7} {'total':>12} {'self':>12} {'avg':>10}",
+    ]
+    ranked = sorted(summary["spans"].items(),
+                    key=lambda kv: -kv[1]["self_us"])[:top]
+    for name, s in ranked:
+        avg = s["total_us"] / s["count"] if s["count"] else 0.0
+        lines.append(
+            f"  {name:<28} {s['count']:>7} {_ms(s['total_us']):>12} "
+            f"{_ms(s['self_us']):>12} {_ms(avg):>10}")
+    lines.append("")
+    lines.append("per-rank totals:")
+    for rank in sorted(summary["ranks"]):
+        r = summary["ranks"][rank]
+        lines.append(
+            f"  rank {rank}: {r['count']} spans, {_ms(r['total_us'])}")
+    if summary["instants"]:
+        lines.append("")
+        lines.append("instant events:")
+        for name in sorted(summary["instants"]):
+            lines.append(f"  {name}: {summary['instants'][name]}")
+    return "\n".join(lines)
+
+
+def main(argv: List[str]) -> int:
+    if not argv or argv[0] in ("-h", "--help"):
+        print("usage: python -m xgboost_tpu trace-report <trace-file> "
+              "[--top N]", file=sys.stderr)
+        return 0 if argv else 1
+    top = 20
+    if "--top" in argv:
+        i = argv.index("--top")
+        try:
+            top = int(argv[i + 1])
+        except (IndexError, ValueError):
+            print("usage: python -m xgboost_tpu trace-report <trace-file> "
+                  "[--top N]", file=sys.stderr)
+            return 1
+        argv = argv[:i] + argv[i + 2:]
+    rc = 0
+    for path in argv:
+        try:
+            events = load_trace(path)
+        except (OSError, ValueError, KeyError) as e:
+            print(f"{path}: unreadable trace: {e}", file=sys.stderr)
+            rc = 1
+            continue
+        if len(argv) > 1:
+            print(f"== {path} ==")
+        print(format_report(summarize(events), top=top))
+    return rc

@@ -1,0 +1,298 @@
+"""Structured span tracing: host-side timeline -> Chrome trace-event JSONL.
+
+The reference ships wall-clock accumulators (``common::Monitor``) and
+compile-gated NVTX ranges; neither produces a machine-readable timeline.
+This module is the unified replacement: a ``span("hist_build", node=k)``
+context manager records Chrome trace-event "X" (complete) events —
+viewable in Perfetto / ``chrome://tracing`` — into an in-memory ring
+buffer, flushed to the path named by ``XGBTPU_TRACE=<path>`` or
+``set_config(trace_path=...)``.
+
+Design constraints (ISSUE 1):
+
+- **Near-zero cost when disabled**: ``span()`` performs one enabled check
+  (an env-cached None test plus a thread-local dict get) and returns a
+  shared no-op context manager. No allocation, no clock read.
+- **Host-side only**: spans measure the Python-side view — argument prep,
+  dispatch, and blocking host syncs — never device internals, and a span
+  opened while JAX is *tracing* a function (inside ``jit``/``shard_map``
+  staging) is suppressed (``jax.core.trace_state_clean``), so wrapped
+  growers can be staged into larger programs without emitting bogus
+  trace-time events. Device-side profiling remains ``jax.profiler``
+  (``utils.timer.profiler_context``).
+- **Ring buffered**: the newest ``XGBTPU_TRACE_BUFFER`` (default 65536)
+  events are retained; older ones are dropped and counted in the
+  ``trace_events_dropped_total`` metric. ``flush()`` drains the buffer to
+  disk (appending), and runs automatically at interpreter exit.
+
+File format: a Chrome trace-event JSON array written one event per line
+(the spec's trailing-``]``-optional form, which both Perfetto and
+``chrome://tracing`` load), so the file doubles as JSONL — each event
+line (modulo the trailing comma) is a complete JSON object, and
+``load_trace`` parses any prefix of a partially written file. Multi-process
+runs write one file per rank (``<path>.rank<r>``), with the rank as the
+Chrome ``pid``.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+__all__ = [
+    "span", "instant", "emit", "enabled", "trace_path", "flush", "reset",
+    "load_trace",
+]
+
+_ENV_PATH = "XGBTPU_TRACE"
+_ENV_BUFFER = "XGBTPU_TRACE_BUFFER"
+
+_lock = threading.RLock()
+_buffer: "collections.deque[Dict[str, Any]]" = collections.deque(
+    maxlen=max(int(os.environ.get(_ENV_BUFFER, "65536") or 65536), 16))
+_dropped = 0
+_headers_written: set = set()
+_tid_map: Dict[int, int] = {}
+_rank_cache: Optional[tuple] = None  # (rank, world)
+_EPOCH_NS = time.perf_counter_ns()
+
+
+def trace_path() -> Optional[str]:
+    """The active trace destination, or None when tracing is off. The
+    ``XGBTPU_TRACE`` env var wins; otherwise the (thread-local)
+    ``set_config(trace_path=...)`` value."""
+    p = os.environ.get(_ENV_PATH)
+    if p:
+        return p
+    from ..config import _state  # direct read: no per-span dict copy
+
+    return _state().get("trace_path") or None
+
+
+def enabled() -> bool:
+    return trace_path() is not None
+
+
+def _host_side() -> bool:
+    """False while JAX is staging (tracing) a program: a span opened there
+    would measure trace-time, not run-time, and would fire once per
+    compilation instead of once per execution."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return True
+    try:
+        return jax.core.trace_state_clean()
+    except Exception:
+        return True
+
+
+def _rank_world() -> tuple:
+    global _rank_cache
+    if _rank_cache is None:
+        try:
+            jax = sys.modules.get("jax")
+            if jax is None:
+                raise RuntimeError("jax not imported")
+            _rank_cache = (jax.process_index(), jax.process_count())
+        except Exception:
+            _rank_cache = (0, 1)
+    return _rank_cache
+
+
+def _tid() -> int:
+    ident = threading.get_ident()
+    t = _tid_map.get(ident)
+    if t is None:
+        with _lock:
+            t = _tid_map.setdefault(ident, len(_tid_map))
+    return t
+
+
+def _record(ev: Dict[str, Any]) -> None:
+    global _dropped
+    with _lock:
+        if len(_buffer) == _buffer.maxlen:
+            _dropped += 1
+            from .metrics import REGISTRY
+
+            REGISTRY.counter(
+                "trace_events_dropped_total",
+                "Trace events evicted from the ring buffer before flush",
+            ).inc()
+        _buffer.append(ev)
+
+
+class _Span:
+    """An open span; emits one Chrome 'X' (complete) event on exit."""
+
+    __slots__ = ("name", "args", "_t0")
+
+    def __init__(self, name: str, args: Dict[str, Any]):
+        self.name = name
+        self.args = args
+
+    def __enter__(self) -> "_Span":
+        self._t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        t1 = time.perf_counter_ns()
+        # NOTE: no rank lookup here — the rank is constant per process and
+        # resolving it can initialize the JAX backend (hundreds of ms);
+        # ``flush`` stamps every event's ``pid`` once instead.
+        ev = {
+            "name": self.name,
+            "ph": "X",
+            "ts": (self._t0 - _EPOCH_NS) // 1000,
+            "dur": max((t1 - self._t0) // 1000, 1),
+            "tid": _tid(),
+        }
+        if self.args:
+            ev["args"] = self.args
+        _record(ev)
+        return False
+
+
+class _NoopSpan:
+    __slots__ = ()
+
+    def __enter__(self) -> "_NoopSpan":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        return False
+
+
+_NOOP = _NoopSpan()
+
+
+def span(name: str, **args: Any):
+    """Context manager timing a host-side phase. ``args`` become the
+    event's Chrome ``args`` payload (keep them JSON-scalar). Disabled or
+    staging-time calls return a shared no-op."""
+    if not enabled() or not _host_side():
+        return _NOOP
+    return _Span(name, args)
+
+
+def emit(name: str, start_ns: int, end_ns: int, **args: Any) -> None:
+    """Record a complete event from a pre-measured ``perf_counter_ns``
+    interval — for instrumentation that already owns its clock reads
+    (``utils.timer.Monitor``)."""
+    if not enabled() or not _host_side():
+        return
+    ev = {
+        "name": name,
+        "ph": "X",
+        "ts": (start_ns - _EPOCH_NS) // 1000,
+        "dur": max((end_ns - start_ns) // 1000, 1),
+        "tid": _tid(),
+    }
+    if args:
+        ev["args"] = args
+    _record(ev)
+
+
+def instant(name: str, **args: Any) -> None:
+    """A zero-duration marker event (Chrome phase 'i')."""
+    if not enabled() or not _host_side():
+        return
+    ev = {
+        "name": name,
+        "ph": "i",
+        "s": "t",
+        "ts": (time.perf_counter_ns() - _EPOCH_NS) // 1000,
+        "tid": _tid(),
+    }
+    if args:
+        ev["args"] = args
+    _record(ev)
+
+
+def _out_path(path: str) -> str:
+    rank, world = _rank_world()
+    return f"{path}.rank{rank}" if world > 1 else path
+
+
+def flush(path: Optional[str] = None) -> Optional[str]:
+    """Drain the ring buffer to ``path`` (default: the active trace path),
+    appending to earlier flushes. Returns the written path, or None when
+    tracing is off and no path was given."""
+    path = path or trace_path()
+    if path is None:
+        return None
+    path = _out_path(path)
+    with _lock:
+        events = list(_buffer)
+        _buffer.clear()
+        need_header = path not in _headers_written
+        _headers_written.add(path)
+    if need_header:
+        try:
+            need_header = os.path.getsize(path) == 0
+        except OSError:
+            need_header = True
+    rank, _ = _rank_world()
+    with open(path, "a") as f:
+        if need_header:
+            f.write("[\n")
+            meta = {
+                "name": "process_name", "ph": "M", "pid": rank, "tid": 0,
+                "args": {"name": f"xgboost_tpu rank {rank}"},
+            }
+            f.write(json.dumps(meta) + ",\n")
+        for ev in events:
+            ev.setdefault("pid", rank)
+            f.write(json.dumps(ev) + ",\n")
+    return path
+
+
+def reset() -> None:
+    """Clear buffered events and per-path header state (tests)."""
+    global _dropped, _rank_cache
+    with _lock:
+        _buffer.clear()
+        _headers_written.clear()
+        _dropped = 0
+        _rank_cache = None
+
+
+def dropped_count() -> int:
+    return _dropped
+
+
+def load_trace(path: str) -> List[Dict[str, Any]]:
+    """Parse a trace file written by ``flush`` (or any Chrome trace-event
+    JSON: complete array, trailing-comma/unterminated array, JSONL, or a
+    ``{"traceEvents": [...]}`` wrapper) into a list of event dicts."""
+    with open(path) as f:
+        text = f.read().strip()
+    if not text:
+        return []
+    try:
+        doc = json.loads(text)
+    except json.JSONDecodeError:
+        doc = None
+    if doc is None and text.startswith("["):
+        # the spec's unterminated-array form: close it
+        doc = json.loads(text.rstrip().rstrip(",") + "\n]")
+    if isinstance(doc, dict):
+        doc = doc.get("traceEvents", [])
+    if doc is None:
+        # JSONL: one event object per line
+        doc = [json.loads(ln.rstrip(",")) for ln in text.splitlines()
+               if ln.strip() and ln.strip() not in ("[", "]")]
+    if not isinstance(doc, list) or not all(
+            isinstance(e, dict) for e in doc):
+        raise ValueError(f"{path}: not a Chrome trace event file")
+    return doc
+
+
+import atexit  # noqa: E402
+
+atexit.register(lambda: flush() if enabled() and len(_buffer) else None)

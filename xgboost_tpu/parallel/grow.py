@@ -60,6 +60,8 @@ def distributed_grow_tree(
     positions stay sharded."""
     import dataclasses
 
+    from ..observability import comms, trace
+
     cfg_dist = dataclasses.replace(cfg, axis_name=ROW_AXIS)
 
     # Build the out_specs programmatically from HeapTree._fields so the
@@ -68,10 +70,13 @@ def distributed_grow_tree(
     out_specs = HeapTree(
         **{f: (P(ROW_AXIS) if f == "positions" else P()) for f in HeapTree._fields}
     )
-    return _row_sharded_call(
-        mesh, partial(grow_tree, cfg=cfg_dist), out_specs,
-        (bins, grad, hess, cut_values, key), feature_weights,
-    )
+    comms.record_grow_collectives(cfg.max_depth, bins.shape[1],
+                                  cut_values.shape[1])
+    with trace.span("distributed_grow_tree", depth=cfg.max_depth):
+        return _row_sharded_call(
+            mesh, partial(grow_tree, cfg=cfg_dist), out_specs,
+            (bins, grad, hess, cut_values, key), feature_weights,
+        )
 
 
 def distributed_grow_tree_fused(
@@ -98,6 +103,10 @@ def distributed_grow_tree_fused(
     row-sharded operand, so each device streams its own resident shard."""
     import dataclasses
 
+    from ..observability import comms
+
+    comms.record_grow_collectives(cfg.max_depth, bins.shape[1],
+                                  cut_values.shape[1])
     cfg_dist = dataclasses.replace(cfg, axis_name=ROW_AXIS)
     out_specs = GrownTree(
         **{f: (P(ROW_AXIS) if f == "delta" else P()) for f in GrownTree._fields}
@@ -145,6 +154,15 @@ def distributed_grow_tree_lossguide(
     histograms), so tree tensors come back replicated."""
     import dataclasses
 
+    from ..observability import comms
+
+    # lossguide reduces one [F, 2, B] child-pair histogram per expansion
+    # step (max_leaves - 1 splits) rather than whole levels
+    comms.record(
+        "psum_hist",
+        max(max_leaves - 1, 1) * bins.shape[1] * 2 * cut_values.shape[1] * 4,
+        n_ops=max(max_leaves - 1, 1),
+    )
     cfg_dist = dataclasses.replace(cfg, axis_name=ROW_AXIS)
     out_specs = AllocTree(
         **{f: (P(ROW_AXIS) if f == "positions" else P()) for f in AllocTree._fields}
@@ -184,8 +202,15 @@ def distributed_boost_rounds_scan(
     round — the fixed-shape analog of the reference's empty-worker
     handling."""
     from ..gbm.gbtree import _obj_fingerprint
+    from ..observability import comms
     from .mesh import local_device_count, replicate
 
+    # one fused tree per group per scanned round, each with the per-level
+    # histogram psums + root-total psum of grow_tree_fused
+    comms.record_grow_collectives(
+        cfg.max_depth, bins.shape[1], cut_values.shape[1],
+        n_trees=int(iters.shape[0]) * margin.shape[1],
+    )
     n_procs = jax.process_count()
     if n_procs > 1:
         # the r // d_local shard->process attribution below requires the

@@ -21,6 +21,8 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .. import _compat  # noqa: F401  (pre-0.5 jax shard_map/pcast shims)
+
 ROW_AXIS = "data"  # the one parallel axis of GBDT training: rows
 
 _state = threading.local()
@@ -107,8 +109,11 @@ def global_pad_rows(n_local: int, unit: int) -> int:
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
 
+        from ..observability import comms
+
         sizes = np.asarray(multihost_utils.process_allgather(
             np.asarray(n_pad, np.int64)))
+        comms.record("process_allgather", 8)
         n_pad = int(sizes.max())
     return n_pad
 
@@ -136,8 +141,11 @@ def _check_equal_blocks(n_local: int) -> None:
     validity mask both assume it). Fails loudly instead of deadlocking."""
     from jax.experimental import multihost_utils
 
+    from ..observability import comms
+
     sizes = np.asarray(multihost_utils.process_allgather(
         np.asarray(n_local, np.int64)))
+    comms.record("process_allgather", 8)
     if not (sizes == sizes[0]).all():
         raise ValueError(
             "multi-process training requires equal PADDED row blocks per "
@@ -171,11 +179,14 @@ def local_rows(arr: jax.Array) -> jax.Array:
     per-row outputs (margins, deltas) back to process-local layout."""
     if jax.process_count() == 1:
         return arr
-    shards = sorted(arr.addressable_shards,
-                    key=lambda s: s.index[0].start or 0)
-    import jax.numpy as jnp
+    from ..observability import trace
 
-    # via host: the shards live committed on DIFFERENT local devices and
-    # cannot be concatenated device-side without explicit transfers
-    return jnp.asarray(
-        np.concatenate([np.asarray(s.data) for s in shards], axis=0))
+    with trace.span("local_rows", bytes=int(arr.nbytes)):
+        shards = sorted(arr.addressable_shards,
+                        key=lambda s: s.index[0].start or 0)
+        import jax.numpy as jnp
+
+        # via host: the shards live committed on DIFFERENT local devices
+        # and cannot be concatenated device-side without explicit transfers
+        return jnp.asarray(
+            np.concatenate([np.asarray(s.data) for s in shards], axis=0))

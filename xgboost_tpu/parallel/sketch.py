@@ -85,9 +85,18 @@ def distributed_compute_cuts(
     max_bin: int = 256,
     weights: Optional[jax.Array] = None,
 ) -> HistogramCuts:
+    from ..observability import comms, trace
+
     n, F = X.shape
     if weights is None:
         weights = jnp.ones((n,), jnp.float32)
+    # per-device volume of the summary merge: four all_gathers (vals/wts
+    # [F, S] + fmax/fmin [F]) over D shards, plus the two psum-broadcasts
+    # of the [F, max_bin] cuts — the quantile.cc:270 AllReduce site
+    D = mesh.devices.size
+    S = OVERSAMPLE * max_bin
+    comms.record("all_gather_sketch", D * (2 * F * S + 2 * F) * 4, n_ops=4)
+    comms.record("psum_hist", 2 * F * max_bin * 4, n_ops=2)
 
     def shard_fn(Xs, ws):
         vals, wts, fmax, fmin = _local_summary(Xs, ws, max_bin)
@@ -109,10 +118,13 @@ def distributed_compute_cuts(
 
         return bcast0(cuts), bcast0(mins)
 
-    cuts, min_vals = jax.shard_map(
-        shard_fn,
-        mesh=mesh,
-        in_specs=(P(ROW_AXIS, None), P(ROW_AXIS)),
-        out_specs=(P(), P()),
-    )(X, weights)
-    return HistogramCuts(values=np.asarray(cuts), min_vals=np.asarray(min_vals))
+    with trace.span("sketch", distributed=True, rows=n, features=F,
+                    max_bin=max_bin):
+        cuts, min_vals = jax.shard_map(
+            shard_fn,
+            mesh=mesh,
+            in_specs=(P(ROW_AXIS, None), P(ROW_AXIS)),
+            out_specs=(P(), P()),
+        )(X, weights)
+        return HistogramCuts(values=np.asarray(cuts),
+                             min_vals=np.asarray(min_vals))

@@ -68,6 +68,8 @@ def train(
 
     import jax
 
+    from .observability import trace as _trace
+
     if _no_per_iter_consumer and jax.default_backend() == "tpu":
         # no per-iteration consumer (no eval lines, early stopping,
         # checkpoints or custom callbacks): train whole chunks as single
@@ -76,14 +78,19 @@ def train(
         # latency, which is what accelerator backends pay; on CPU it only
         # multiplies XLA:CPU compile load (observed LLVM segfaults under
         # the full-suite compile volume), so the classic loop stays.
-        bst.update_many(dtrain, start_round, num_boost_round)
+        with _trace.span("train", rounds=num_boost_round, path="scan"):
+            bst.update_many(dtrain, start_round, num_boost_round)
     else:
-        for i in range(start_round, start_round + num_boost_round):
-            if container.before_iteration(bst, i, dtrain, evals):
-                break
-            bst.update(dtrain, i, fobj=obj)
-            if container.after_iteration(bst, i, dtrain, evals, feval=feval):
-                break
+        with _trace.span("train", rounds=num_boost_round, path="per_round"):
+            for i in range(start_round, start_round + num_boost_round):
+                if container.before_iteration(bst, i, dtrain, evals):
+                    break
+                with _trace.span("round", iteration=i):
+                    bst.update(dtrain, i, fobj=obj)
+                    stop = container.after_iteration(bst, i, dtrain, evals,
+                                                     feval=feval)
+                if stop:
+                    break
 
     bst = container.after_training(bst)
 

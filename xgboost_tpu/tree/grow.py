@@ -449,7 +449,6 @@ def interaction_allowed(used: jax.Array, gmask: jax.Array) -> jax.Array:
     return jnp.where(any_used, used | from_groups, jnp.ones_like(used))
 
 
-@partial(jax.jit, static_argnames=("cfg",))
 def grow_tree(
     bins: jax.Array,  # [n, F] narrow int bin ids (missing == max_bin)
     grad: jax.Array,  # [n] f32
@@ -458,6 +457,29 @@ def grow_tree(
     key: jax.Array,
     cfg: GrowParams,
     feature_weights: Optional[jax.Array] = None,  # [F] sampling weights
+) -> HeapTree:
+    """Host entry point: times the compiled dispatch as a ``grow_tree``
+    span (hist build + split eval + partition for the whole tree). When
+    invoked during program staging (inside ``shard_map``/``scan`` tracing,
+    e.g. ``parallel.grow``) the span layer suppresses itself — telemetry
+    stays host-side only."""
+    from ..observability import trace
+
+    with trace.span("grow_tree", depth=cfg.max_depth,
+                    features=int(bins.shape[1])):
+        return _grow_tree_impl(bins, grad, hess, cut_values, key, cfg,
+                               feature_weights)
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def _grow_tree_impl(
+    bins: jax.Array,
+    grad: jax.Array,
+    hess: jax.Array,
+    cut_values: jax.Array,
+    key: jax.Array,
+    cfg: GrowParams,
+    feature_weights: Optional[jax.Array] = None,
 ) -> HeapTree:
     n, F = bins.shape
     B = cut_values.shape[1]

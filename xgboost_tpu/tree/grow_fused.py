@@ -308,7 +308,6 @@ def _finalize(st: _HeapState, eta, gamma, cfg: GrowParams):
     return keep, leaf_value
 
 
-@functools.partial(jax.jit, static_argnames=("cfg",))
 def grow_tree_fused(
     bins: jax.Array,  # [n_pad, F] narrow-int bins (missing == B; pads all-B)
     grad: jax.Array,  # [n_pad] f32 (pad rows zero)
@@ -320,6 +319,31 @@ def grow_tree_fused(
     cfg: GrowParams,
     feature_weights: Optional[jax.Array] = None,
     onehot: Optional[jax.Array] = None,  # [n_pad, F*B] int8 (hoisted)
+) -> GrownTree:
+    """Host entry point: times the compiled whole-tree dispatch as a
+    ``grow_tree`` span. Suppressed while a larger program (scan chunk /
+    shard_map) is being staged around it — telemetry is host-side only."""
+    from ..observability import trace
+
+    with trace.span("grow_tree", fused=True, depth=cfg.max_depth,
+                    features=int(bins.shape[1])):
+        return _grow_tree_fused_impl(bins, grad, hess, cut_values, key,
+                                     eta, gamma, cfg, feature_weights,
+                                     onehot)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def _grow_tree_fused_impl(
+    bins: jax.Array,
+    grad: jax.Array,
+    hess: jax.Array,
+    cut_values: jax.Array,
+    key: jax.Array,
+    eta: jax.Array,
+    gamma: jax.Array,
+    cfg: GrowParams,
+    feature_weights: Optional[jax.Array] = None,
+    onehot: Optional[jax.Array] = None,
 ) -> GrownTree:
     bins = bins.astype(jnp.int32)  # transient in-program widening
     n, F = bins.shape
@@ -429,6 +453,25 @@ def grow_tree_fused_paged(
     the in-core path (shared ``_level_update``/``_finalize``)."""
     assert cfg.axis_name is None, "paged + mesh not supported yet"
     assert not cfg.has_categorical
+    from ..observability import trace as _trace
+
+    with _trace.span("grow_tree_paged", depth=cfg.max_depth,
+                     pages=paged.n_pages):
+        return _grow_tree_fused_paged(paged, grad, hess, cut_values, key,
+                                      eta, gamma, cfg, feature_weights)
+
+
+def _grow_tree_fused_paged(
+    paged,
+    grad: np.ndarray,
+    hess: np.ndarray,
+    cut_values: jax.Array,
+    key: jax.Array,
+    eta: float,
+    gamma: float,
+    cfg: GrowParams,
+    feature_weights: Optional[jax.Array] = None,
+) -> GrownTree:
     B = cut_values.shape[1]
     F = paged.n_features
     n = paged.n_rows

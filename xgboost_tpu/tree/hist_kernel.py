@@ -110,8 +110,11 @@ def hoist_plan_synced(n_pad: int, F: int, B: int, max_depth: int = 6) -> int:
 
         from jax.experimental import multihost_utils
 
+        from ..observability import comms
+
         all_fh = _np.asarray(multihost_utils.process_allgather(
             _np.asarray(fh, _np.int64)))
+        comms.record("process_allgather", 8)
         fh = int(all_fh.min())
     return fh
 
@@ -228,12 +231,15 @@ def build_onehot(bins: jax.Array, *, B: int, vma=()) -> jax.Array:
     (see ``_build_onehot_pallas``), elsewhere by XLA broadcast-compare
     (small shapes only — tests, narrow matrices). ``vma`` annotates the
     output's varying axes when building inside ``shard_map``."""
+    from ..observability import trace
+
     n, F = bins.shape
-    if use_pallas() or _INTERPRET:
-        tr = _build_tr(n, F, B)
-        if F > 0 and tr:
-            return _build_onehot_pallas(bins, B=B, tr=tr, vma=vma)
-    return _build_onehot_xla(bins, B=B)
+    with trace.span("onehot_build", rows=int(n), features=int(F), B=B):
+        if use_pallas() or _INTERPRET:
+            tr = _build_tr(n, F, B)
+            if F > 0 and tr:
+                return _build_onehot_pallas(bins, B=B, tr=tr, vma=vma)
+        return _build_onehot_xla(bins, B=B)
 
 
 def _split_hilo(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
@@ -348,7 +354,12 @@ def _vma_struct(shape, dtype, axes):
     check_vma demands of pallas_call outputs (per-shard kernel results vary
     over the row axis; the psum above the kernel restores invariance)."""
     if axes:
-        return jax.ShapeDtypeStruct(shape, dtype, vma=frozenset(axes))
+        try:
+            return jax.ShapeDtypeStruct(shape, dtype, vma=frozenset(axes))
+        except TypeError:
+            # pre-vma jax: shard_map runs with replication checking off
+            # (parallel/mesh.py compat alias), so no annotation is needed
+            pass
     return jax.ShapeDtypeStruct(shape, dtype)
 
 

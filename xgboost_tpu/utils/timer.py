@@ -1,10 +1,18 @@
-"""Per-label accumulating timers.
+"""Per-label accumulating timers — a thin adapter over the telemetry layer.
 
 Analog of ``common::Monitor`` (``src/common/timer.h:16,47``): label ->
 accumulated wall time + call count per component, printed at verbosity>=3.
-On TPU the heavyweight profiling story is ``jax.profiler``; this is the
-cheap always-on host-side accumulator the reference keeps around every
-phase (learner.cc:1061-1085).
+Since ISSUE 1 the Monitor is an adapter over ``observability``: every
+``stop`` ALSO feeds the ``monitor_seconds{monitor=,section=}`` histogram in
+the process-wide metrics registry and emits a span on the active trace
+(``XGBTPU_TRACE``), so existing call sites (``learner.py``'s
+GetGradient/GetBinned/BoostOneRound sections) appear in Perfetto timelines
+and Prometheus dumps with zero changes. The local ``stats`` dict and
+``report()`` format are preserved for the verbosity>=3 stderr path.
+
+On TPU the heavyweight device profiling story remains ``jax.profiler``
+(``profiler_context`` below); the Monitor is the cheap always-on host-side
+accumulator the reference keeps around every phase (learner.cc:1061-1085).
 """
 
 from __future__ import annotations
@@ -14,23 +22,31 @@ import time
 from typing import Dict, Iterator, Tuple
 
 from ..config import get_config
+from ..observability import metrics as _metrics, trace as _trace
+
+_MONITOR_HELP = "Host-side wall time per Monitor section"
 
 
 class Monitor:
     def __init__(self, label: str):
         self.label = label
         self.stats: Dict[str, Tuple[float, int]] = {}
-        self._open: Dict[str, float] = {}
+        self._open: Dict[str, int] = {}
 
     def start(self, name: str) -> None:
-        self._open[name] = time.perf_counter()
+        self._open[name] = time.perf_counter_ns()
 
     def stop(self, name: str) -> None:
         t0 = self._open.pop(name, None)
         if t0 is None:
             return
+        t1 = time.perf_counter_ns()
+        dt = (t1 - t0) * 1e-9
         acc, n = self.stats.get(name, (0.0, 0))
-        self.stats[name] = (acc + time.perf_counter() - t0, n + 1)
+        self.stats[name] = (acc + dt, n + 1)
+        _metrics.REGISTRY.histogram("monitor_seconds", _MONITOR_HELP).labels(
+            monitor=self.label, section=name).observe(dt)
+        _trace.emit(name, t0, t1, monitor=self.label)
 
     @contextlib.contextmanager
     def section(self, name: str) -> Iterator[None]:
