@@ -419,6 +419,121 @@ def test_c_api_set_uint_info_exact_above_2_24(lib):
     _check(lib, lib.XGDMatrixFree(h))
 
 
+def _array_interface(arr: np.ndarray) -> bytes:
+    """__array_interface__ JSON over a numpy array's live buffer — the
+    payload XGBoosterPredictFromDense/CSR take (c_api.cc:833)."""
+    import json
+
+    return json.dumps({
+        "data": [arr.ctypes.data, True],
+        "shape": list(arr.shape),
+        "typestr": arr.__array_interface__["typestr"],
+        "version": 3,
+    }).encode()
+
+
+def _inplace_argtypes(lib):
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    f32pp = ctypes.POINTER(ctypes.POINTER(ctypes.c_float))
+    lib.XGBoosterPredictFromDense.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_void_p,
+        ctypes.POINTER(u64p), ctypes.POINTER(ctypes.c_uint64), f32pp]
+    lib.XGBoosterPredictFromCSR.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.c_uint64, ctypes.c_char_p, ctypes.c_void_p,
+        ctypes.POINTER(u64p), ctypes.POINTER(ctypes.c_uint64), f32pp]
+
+
+def test_c_api_inplace_predict_dense_and_csr(lib):
+    """XGBoosterPredictFromDense/CSR (zero-copy inplace, c_api.cc:833):
+    value + margin types, missing sentinel, iteration_range — all matching
+    the Python inplace_predict bit-for-bit."""
+    import json
+
+    import scipy.sparse as sp
+
+    X, y = _data(400, 5, seed=21)
+    n, F = X.shape
+    d = xgb.DMatrix(X, label=y)
+    params = {"objective": "binary:logistic", "max_depth": 3, "seed": 7,
+              "verbosity": 0}
+    bst = xgb.train(params, d, 4)
+    blob = bst.save_raw()
+    bh = ctypes.c_void_p()
+    _check(lib, lib.XGBoosterCreate(None, 0, ctypes.byref(bh)))
+    _check(lib, lib.XGBoosterLoadModelFromBuffer(bh, blob, len(blob)))
+    _inplace_argtypes(lib)
+
+    shp = ctypes.POINTER(ctypes.c_uint64)()
+    dim = ctypes.c_uint64()
+    res = ctypes.POINTER(ctypes.c_float)()
+
+    def run_dense(arr, cfg: dict):
+        _check(lib, lib.XGBoosterPredictFromDense(
+            bh, _array_interface(arr), json.dumps(cfg).encode(), None,
+            ctypes.byref(shp), ctypes.byref(dim), ctypes.byref(res)))
+        shape = tuple(shp[i] for i in range(dim.value))
+        count = int(np.prod(shape))
+        return np.ctypeslib.as_array(res, shape=(count,)).copy().reshape(
+            shape)
+
+    Xc = np.ascontiguousarray(X)
+    np.testing.assert_array_equal(
+        run_dense(Xc, {"type": 0}),
+        np.asarray(bst.inplace_predict(X), np.float32))
+    np.testing.assert_array_equal(
+        run_dense(Xc, {"type": 1}),
+        np.asarray(bst.inplace_predict(X, predict_type="margin"),
+                   np.float32))
+    np.testing.assert_array_equal(
+        run_dense(Xc, {"type": 0, "iteration_begin": 0,
+                       "iteration_end": 2}),
+        np.asarray(bst.inplace_predict(X, iteration_range=(0, 2)),
+                   np.float32))
+    # missing sentinel: -999 entries must route like NaN
+    Xm = np.ascontiguousarray(np.where(np.isnan(X), np.float32(-999), X))
+    Xm[::7, 0] = -999.0
+    np.testing.assert_array_equal(
+        run_dense(Xm, {"type": 0, "missing": -999.0}),
+        np.asarray(bst.inplace_predict(Xm, missing=-999.0), np.float32))
+
+    # ---- CSR ----
+    Xs = sp.random(200, F, density=0.5, format="csr", random_state=3,
+                   dtype=np.float32)
+    indptr = np.ascontiguousarray(Xs.indptr.astype(np.uint64))
+    indices = np.ascontiguousarray(Xs.indices.astype(np.uint32))
+    values = np.ascontiguousarray(Xs.data)
+    _check(lib, lib.XGBoosterPredictFromCSR(
+        bh, _array_interface(indptr), _array_interface(indices),
+        _array_interface(values), F, json.dumps({"type": 0}).encode(),
+        None, ctypes.byref(shp), ctypes.byref(dim), ctypes.byref(res)))
+    shape = tuple(shp[i] for i in range(dim.value))
+    out = np.ctypeslib.as_array(
+        res, shape=(int(np.prod(shape)),)).copy().reshape(shape)
+    np.testing.assert_array_equal(
+        out, np.asarray(bst.inplace_predict(Xs), np.float32))
+    # iteration_begin with end=0 means rounds begin..end (review finding:
+    # the range must not be dropped when only begin is set)
+    np.testing.assert_array_equal(
+        run_dense(Xc, {"type": 0, "iteration_begin": 2,
+                       "iteration_end": 0}),
+        np.asarray(bst.inplace_predict(X, iteration_range=(2, 0)),
+                   np.float32))
+    # unsupported type must fail loudly with a retrievable message
+    rc = lib.XGBoosterPredictFromDense(
+        bh, _array_interface(Xc), json.dumps({"type": 6}).encode(), None,
+        ctypes.byref(shp), ctypes.byref(dim), ctypes.byref(res))
+    assert rc == -1 and lib.XGBGetLastError()
+    # malformed config (string where an int belongs) errors instead of
+    # silently predicting with all trees
+    rc = lib.XGBoosterPredictFromDense(
+        bh, _array_interface(Xc),
+        json.dumps({"type": 0, "iteration_end": "3"}).encode(), None,
+        ctypes.byref(shp), ctypes.byref(dim), ctypes.byref(res))
+    assert rc == -1 and lib.XGBGetLastError()
+    _check(lib, lib.XGBoosterFree(bh))
+
+
 def test_c_api_predict_ntree_limit_counts_trees(lib):
     """XGBoosterPredict regression (ISSUE 1 satellite): ntree_limit counts
     TREES, not rounds — on a multiclass model (num_class trees per round)

@@ -371,16 +371,19 @@ def test_inplace_predict_matches_dmatrix_predict():
     d = xgb.DMatrix(X, label=y)
     bst = xgb.train({"objective": "binary:logistic", "max_depth": 4}, d, 5,
                     verbose_eval=False)
+    # the serving path's native walker accumulates in double, so parity
+    # with the XLA segment_sum is float32 round-off — the contract is
+    # |diff| < 1e-5 on margins (docs/serving.md), not bit identity
     p1 = bst.predict(xgb.DMatrix(X))
     p2 = bst.inplace_predict(X)
-    np.testing.assert_allclose(p1, p2, rtol=1e-6)
+    np.testing.assert_allclose(p1, p2, rtol=1e-6, atol=1e-6)
     m = bst.inplace_predict(X, predict_type="margin")
     np.testing.assert_allclose(
-        m, bst.predict(xgb.DMatrix(X), output_margin=True), rtol=1e-6)
+        m, bst.predict(xgb.DMatrix(X), output_margin=True), atol=1e-5)
     # missing sentinel handling on the fast path
     Xs = np.nan_to_num(X, nan=-999.0)
     p3 = bst.inplace_predict(Xs, missing=-999.0)
-    np.testing.assert_allclose(p1, p3, rtol=1e-6)
+    np.testing.assert_allclose(p1, p3, rtol=1e-6, atol=1e-6)
 
 
 def test_approx_resketeches_per_iteration():

@@ -16,7 +16,52 @@ from typing import Any, List, Optional, Tuple
 
 import numpy as np
 
-__all__ = ["dispatch_data", "load_svmlight", "load_csv"]
+__all__ = [
+    "dispatch_data", "load_svmlight", "load_csv",
+    "from_array_interface", "csr_from_array_interface",
+]
+
+
+def from_array_interface(spec: Any) -> np.ndarray:
+    """Zero-copy numpy view over caller-owned memory described by an
+    ``__array_interface__`` JSON document — the payload format of the
+    reference's inplace-predict C entries (``XGBoosterPredictFromDense``,
+    c_api.cc:833, whose ``values`` argument is exactly this JSON). The
+    caller guarantees the memory outlives the view; nothing here copies."""
+    import json as _json
+
+    if isinstance(spec, (bytes, bytearray)):
+        spec = spec.decode()
+    if isinstance(spec, str):
+        spec = _json.loads(spec)
+    data = spec["data"]
+    iface = {
+        "data": (int(data[0]), bool(data[1])),
+        "shape": tuple(int(s) for s in spec["shape"]),
+        "typestr": str(spec["typestr"]),
+        "version": 3,
+    }
+    if spec.get("strides"):
+        iface["strides"] = tuple(int(s) for s in spec["strides"])
+    holder = type("_ArrayInterfaceView", (), {"__array_interface__": iface})()
+    # keep the holder alive with the view (numpy tracks it as .base)
+    return np.asarray(holder)
+
+
+def csr_from_array_interface(indptr: Any, indices: Any, values: Any,
+                             ncol: int):
+    """scipy CSR over caller-owned buffers, each described by an
+    ``__array_interface__`` JSON document (the reference's
+    ``XGBoosterPredictFromCSR`` payload, c_api.cc:878). scipy may narrow
+    the index dtypes (a copy of the two index arrays); the float payload
+    is taken as-is."""
+    import scipy.sparse as sp
+
+    pi = from_array_interface(indptr)
+    px = from_array_interface(indices)
+    pv = from_array_interface(values)
+    n = int(pi.shape[0]) - 1
+    return sp.csr_matrix((pv, px, pi), shape=(n, int(ncol)))
 
 
 def _from_scipy(data: Any, missing: float) -> Tuple[np.ndarray, Optional[List[str]]]:

@@ -15,6 +15,8 @@ from __future__ import annotations
 
 import json
 import os
+import threading
+from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
@@ -73,6 +75,14 @@ class Booster:
         self._base_margin_val: float = 0.0
         self._caches: Dict[int, _PredCache] = {}
         self._cache_refs: Dict[int, DMatrix] = {}
+        # stacked-forest snapshots keyed by (num_trees, resolved
+        # iteration_range): repeated predicts — the serving pattern — must
+        # not re-stack/re-pad trees per request (see _forest_snapshot).
+        # Lock-guarded: a multi-threaded serving frontend hits this from
+        # concurrent inplace_predict calls (lock recreated on unpickle via
+        # __setstate__ -> __init__)
+        self._forest_snapshots: "OrderedDict" = OrderedDict()
+        self._forest_snapshots_lock = threading.Lock()
         self.attributes_: Dict[str, str] = {}
         self.best_iteration: Optional[int] = None
         self.best_score: Optional[float] = None
@@ -294,6 +304,7 @@ class Booster:
                         dtrain.data, grad, hess, iteration
                     )
                 entry.margin = None  # leaf values changed
+                self._forest_snapshots.clear()  # same num_trees, new leaves
                 return
             if getattr(self._gbm, "needs_local_sketch", False):
                 # updater=grow_local_histmaker: per-node re-sketched cuts,
@@ -501,22 +512,62 @@ class Booster:
                 "Predict from an in-memory DMatrix for exact results.",
                 UserWarning, stacklevel=4)
 
+    def _forest_snapshot(self, iteration_range=None):
+        """(StackedForest, tree_weights) for the current model restricted to
+        ``iteration_range`` (None or (0, 0) = all rounds), LRU-cached keyed
+        by (num_trees, resolved range). The stacking/padding work — host
+        tree walks, pow2 padding, device transfer — happens once per model
+        version, not once per predict call: this is what lets a serving
+        loop issue thousands of ``inplace_predict`` calls without touching
+        the tree store (reference analog: gbtree keeps its device model
+        resident across PredictBatch calls, gpu_predictor.cu)."""
+        self._configure()
+        if iteration_range is not None and tuple(iteration_range) == (0, 0):
+            iteration_range = None
+        cur = self._gbm.model.num_trees
+        if iteration_range is None:
+            rkey = None
+        else:
+            lo, hi = iteration_range
+            if hi == 0:
+                hi = self.num_boosted_rounds()
+            rkey = (int(lo), int(hi))
+        key = (cur, rkey)
+        with self._forest_snapshots_lock:
+            hit = self._forest_snapshots.get(key)
+            if hit is not None:
+                self._forest_snapshots.move_to_end(key)
+                _REGISTRY.counter(
+                    "predict_forest_snapshot_hits_total",
+                    "Predicts served from a cached stacked forest").inc()
+                return hit
+        _REGISTRY.counter(
+            "predict_forest_snapshot_misses_total",
+            "Stacked-forest (re)builds for predict").inc()
+        tw = self._gbm.tree_weights()
+        if rkey is None:
+            forest = self._gbm.model.stacked()
+        else:
+            lo, hi = rkey
+            forest = self._gbm.model.slice(lo, hi).stacked()
+            if tw is not None:
+                per_round = max(1, self._gbm.n_groups) * \
+                    self._gbm.gbtree_param.num_parallel_tree
+                tw = tw[lo * per_round: hi * per_round]
+        with self._forest_snapshots_lock:
+            self._forest_snapshots[key] = (forest, tw)
+            while len(self._forest_snapshots) > 4:
+                self._forest_snapshots.popitem(last=False)
+        return forest, tw
+
     def _predict_margin(self, dmat: DMatrix, iteration_range=None) -> jax.Array:
         self._configure()
         n = dmat.num_row()
         base = self._base_margin_for(dmat, n)
         if iteration_range is not None and self._gbm.name in ("gbtree", "dart"):
-            lo, hi = iteration_range
-            if hi == 0:
-                hi = self.num_boosted_rounds()
-            sub = self._gbm.model.slice(lo, hi)
             from .predictor import predict_margin as _pm
 
-            tw = self._gbm.tree_weights()
-            if tw is not None:
-                per_round = max(1, self._gbm.n_groups) * self._gbm.gbtree_param.num_parallel_tree
-                tw = tw[lo * per_round : hi * per_round]
-            stacked = sub.stacked()
+            stacked, tw = self._forest_snapshot(iteration_range)
             parts = [_pm(stacked, X, base[blo:bhi], tw)
                      for blo, bhi, X in self._data_blocks(dmat)]
             return jnp.concatenate(parts, axis=0) if len(parts) > 1 else parts[0]
@@ -655,19 +706,20 @@ class Booster:
             out = out[:, 0]
         return out
 
-    def inplace_predict(self, data, iteration_range=None, predict_type="value", missing=np.nan, base_margin=None, validate_features=True, strict_shape=False):
-        """In-place predict from raw arrays — no DMatrix, no copy of the
-        input beyond the device transfer (reference:
-        XGBoosterPredictFromDense c_api.cc:833 / the adapter-templated
-        predictors)."""
-        self._configure()
-        fast = (
-            isinstance(data, np.ndarray)
-            and data.ndim == 2
-            and iteration_range is None
-            and self._gbm.name in ("gbtree", "dart")
-        )
-        if fast:
+    def _inplace_normalize(self, data, missing):
+        """Raw input -> [n, F] float32 with NaN missing, with the minimum
+        copying the dtype/missing semantics allow. Returns None for inputs
+        the zero-copy path does not understand (those take the DMatrix
+        fallback)."""
+        if hasattr(data, "tocsr") and hasattr(data, "nnz"):
+            # scipy CSR/CSC/COO: normalize stored values (user sentinel ->
+            # NaN; absent entries are missing) but keep the CSR structure —
+            # the native serving walker consumes it without densification
+            # (same semantics as DMatrix ingestion, data/sparse.py)
+            from .data.sparse import CSRStorage
+
+            return CSRStorage(data, missing)
+        if isinstance(data, np.ndarray) and data.ndim == 2:
             X = data
             if X.dtype != np.float32:
                 X = X.astype(np.float32)
@@ -675,29 +727,73 @@ class Booster:
                 isinstance(missing, float) and np.isnan(missing)
             ):
                 X = np.where(X == missing, np.nan, X)
-            n = X.shape[0]
-            K = self.n_groups
+            return np.ascontiguousarray(X)
+        if isinstance(data, (list, tuple)):
+            return self._inplace_normalize(
+                np.asarray(data, np.float32), missing)
+        return None
+
+    def inplace_predict(self, data, iteration_range=None,
+                        predict_type="value", missing=np.nan,
+                        base_margin=None, validate_features=True,
+                        strict_shape=False):
+        """In-place predict from raw arrays — no DMatrix, no quantile work,
+        no copy of the input beyond the device transfer (reference:
+        ``XGBoosterPredictFromDense/CSR``, c_api.cc:833, and core.py
+        ``Booster.inplace_predict``).
+
+        Serving-grade: rows pad up to a power-of-two bucket and the
+        compiled program is cached per (bucket, forest-shape, output-kind)
+        with an LRU bound, so a stream of ragged batch sizes never
+        recompiles (``predictor/serving.py``; cache counters live in the
+        observability registry). The stacked forest itself is snapshotted
+        per (num_trees, iteration_range) on this Booster. ``predict_type``
+        is ``"value"`` (transformed, fused into the program) or
+        ``"margin"``; anything else raises — leaf/contribution outputs go
+        through :meth:`predict`."""
+        self._configure()
+        if predict_type not in ("value", "margin"):
+            raise ValueError(
+                f"inplace_predict supports predict_type 'value' and "
+                f"'margin', got {predict_type!r}; use Booster.predict for "
+                "leaf/contribution outputs")
+        if iteration_range is not None and tuple(iteration_range) == (0, 0):
+            iteration_range = None
+        X = (self._inplace_normalize(data, missing)
+             if self._gbm.name in ("gbtree", "dart") else None)
+        if X is None:
+            d = DMatrix(data, missing=missing)
             if base_margin is not None:
-                base = jnp.asarray(np.asarray(base_margin, np.float32)).reshape(n, K)
-            else:
-                base = jnp.full((n, K), self._base_margin_val, jnp.float32)
-            margin = self._gbm.predict(X, base)
-            if predict_type == "margin":
-                out = margin
-            else:
-                out = self._obj.pred_transform(
-                    margin[:, 0] if K == 1 else margin
-                )
-            out = np.asarray(out)
-            if out.ndim == 2 and out.shape[1] == 1 and not strict_shape:
-                out = out[:, 0]
-            return out
-        d = DMatrix(data, missing=missing)
+                d.set_base_margin(base_margin)
+            return self.predict(
+                d, output_margin=(predict_type == "margin"),
+                iteration_range=iteration_range, strict_shape=strict_shape)
+        n, F = X.shape
+        if validate_features:
+            # _num_feature() from a loaded model is max(split index)+1 — a
+            # LOWER bound on the training width — so only narrower inputs
+            # are definitely wrong (the walk would gather out of range)
+            nf = self._num_feature()
+            if nf and F < nf:
+                raise ValueError(
+                    f"feature count mismatch: model needs >= {nf} "
+                    f"features, input has {F}")
+        K = self.n_groups
         if base_margin is not None:
-            d.set_base_margin(base_margin)
-        if predict_type == "margin":
-            return self.predict(d, output_margin=True, iteration_range=iteration_range, strict_shape=strict_shape)
-        return self.predict(d, iteration_range=iteration_range, strict_shape=strict_shape)
+            base = np.asarray(base_margin, np.float32).reshape(n, K)
+        else:
+            base = np.full((n, K), self._base_margin_val, np.float32)
+        forest, tw = self._forest_snapshot(iteration_range)
+        from .predictor.serving import predict_serving
+
+        transform = (None if predict_type == "margin"
+                     else self._obj.pred_transform)
+        out = predict_serving(forest, X, base, tw, transform=transform)
+        if out.ndim == 2 and out.shape[1] == 1 and not strict_shape:
+            out = out[:, 0]
+        elif strict_shape and out.ndim == 1:
+            out = out.reshape(n, 1)
+        return out
 
     # ------------------------------------------------------------------
     # model IO (XGBoost-JSON-schema-compatible layout, doc/model.schema)
@@ -764,6 +860,7 @@ class Booster:
         self._loaded_feature_names = list(learner.get("feature_names", []))
         self._loaded_feature_types = list(learner.get("feature_types", []))
         self._caches.clear()
+        self._forest_snapshots.clear()
 
     def load_model(self, fname: Union[str, bytes, os.PathLike]) -> None:
         if isinstance(fname, (bytes, bytearray)):
@@ -1120,6 +1217,7 @@ class Booster:
         out = self.copy()
         out._gbm.model = out._gbm.model.slice(start, stop, step)
         out._caches.clear()
+        out._forest_snapshots.clear()
         return out
 
     def trees_to_dataframe(self, fmap: str = ""):

@@ -169,6 +169,50 @@ def load_csv_native(path: str) -> Optional[Tuple[np.ndarray, np.ndarray]]:
     return X, y
 
 
+_SV_SRC = os.path.join(_HERE, "serving_walk.cpp")
+_SV_LIB = os.path.join(_HERE, "libservingwalk.so")
+_sv_lib: Optional[ctypes.CDLL] = None
+_sv_tried = False
+
+
+def get_serving_lib() -> Optional[ctypes.CDLL]:
+    """Load (building on demand) the native serving forest walker
+    (``serving_walk.cpp`` — the cpu_predictor.cc block-of-rows analog);
+    None when unavailable (callers fall back to the XLA walk)."""
+    global _sv_lib, _sv_tried
+    with _lock:
+        if _sv_lib is not None or _sv_tried:
+            return _sv_lib
+        _sv_tried = True
+        ok = _compile(_SV_SRC, _SV_LIB,
+                      ["-O3", "-march=native", "-fopenmp"])
+        if not ok:  # toolchains without OpenMP: single-threaded walker
+            ok = _compile(_SV_SRC, _SV_LIB, ["-O3", "-march=native"])
+        if not ok:
+            return None
+        try:
+            lib = ctypes.CDLL(_SV_LIB)
+        except OSError:
+            return None
+        c = ctypes
+        lib.sv_predict_dense.argtypes = [
+            c.c_void_p, c.c_int64, c.c_int64,  # X, n, F
+            c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p,
+            c.c_void_p, c.c_void_p, c.c_int64, c.c_int64,  # ...T, N
+            c.c_void_p, c.c_void_p, c.c_int64,  # base, out, K
+        ]
+        lib.sv_predict_dense.restype = c.c_int
+        lib.sv_predict_csr.argtypes = [
+            c.c_void_p, c.c_void_p, c.c_void_p, c.c_int64, c.c_int64,
+            c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p,
+            c.c_void_p, c.c_void_p, c.c_int64, c.c_int64,
+            c.c_void_p, c.c_void_p, c.c_int64,
+        ]
+        lib.sv_predict_csr.restype = c.c_int
+        _sv_lib = lib
+        return _sv_lib
+
+
 _CAPI_SRC = os.path.join(_HERE, "c_api.cpp")
 _CAPI_LIB = os.path.join(_HERE, "libxgbtpu.so")
 _capi_path: Optional[str] = None

@@ -16,6 +16,7 @@
 //   XGBoosterBoostOneIter            c_api.h:820
 //   XGBoosterEvalOneIter             c_api.h:835
 //   XGBoosterPredict                 c_api.h:865 (option_mask 0/1)
+//   XGBoosterPredictFromDense/CSR    c_api.cc:833 (zero-copy inplace)
 //   XGBoosterSaveModel/LoadModel, XGBoosterGetNumFeature
 //   XGBoosterSetAttr/GetAttr, XGBVersion
 // Error contract matches the reference: every call returns 0 on success,
@@ -730,6 +731,180 @@ XGB_DLL int XGBoosterDumpModel(BoosterHandle handle, const char *fmap,
   return 0;
 }
 
+namespace {
+
+// capture a predict result (1-D or 2-D numpy array) into the wrap's
+// shape + flat-float buffers (shared by the DMatrix and inplace entries)
+int capture_pred(BoosterWrap *w, PyObject *r, bst_ulong const **out_shape,
+                 bst_ulong *out_dim, float const **out_result) {
+  PyObject *shp = PyObject_GetAttrString(r, "shape");
+  if (shp == nullptr) return fail();
+  Py_ssize_t nd = PyTuple_Check(shp) ? PyTuple_Size(shp) : -1;
+  if (nd < 0) {
+    Py_DECREF(shp);
+    return fail_msg("predict returned a non-array");
+  }
+  w->pred_shape.clear();
+  for (Py_ssize_t i = 0; i < nd; ++i) {
+    PyObject *dim = PyTuple_GetItem(shp, i);
+    w->pred_shape.push_back(
+        static_cast<bst_ulong>(PyLong_AsUnsignedLongLong(dim)));
+  }
+  Py_DECREF(shp);
+  int rc = np_to(r, &w->pred);
+  if (rc != 0) return rc;
+  *out_shape = w->pred_shape.data();
+  *out_dim = static_cast<bst_ulong>(w->pred_shape.size());
+  *out_result = w->pred.data();
+  return 0;
+}
+
+// shared body of XGBoosterPredictFromDense/CSR: `data` (borrowed ref) is a
+// numpy array / scipy CSR built zero-copy over caller memory; the JSON
+// config carries type (0 value / 1 margin), missing, iteration_begin/end,
+// strict_shape (reference c_api.cc:833). `m` is the reference's optional
+// proxy-DMatrix metadata carrier: its base_margin is forwarded when set.
+int inplace_predict_common(BoosterWrap *w, PyObject *data,
+                           char const *c_json_config, DMatrixHandle m,
+                           bst_ulong const **out_shape, bst_ulong *out_dim,
+                           float const **out_result) {
+  PyObject *jmod = imp("json");
+  if (jmod == nullptr) return fail();
+  PyObject *cfg = PyObject_CallMethod(
+      jmod, "loads", "s",
+      (c_json_config == nullptr || c_json_config[0] == '\0') ? "{}"
+                                                             : c_json_config);
+  if (cfg == nullptr) return fail();
+  long type = 0, it_begin = 0, it_end = 0, strict = 0;
+  double missing = NAN;
+  PyObject *v;
+  if ((v = PyDict_GetItemString(cfg, "type"))) type = PyLong_AsLong(v);
+  if ((v = PyDict_GetItemString(cfg, "missing")) && v != Py_None) {
+    if (!PyNumber_Check(v)) {
+      Py_DECREF(cfg);
+      return fail_msg(
+          "inplace predict: 'missing' must be a number (or null)");
+    }
+    missing = PyFloat_AsDouble(v);
+  }
+  if ((v = PyDict_GetItemString(cfg, "iteration_begin")))
+    it_begin = PyLong_AsLong(v);
+  if ((v = PyDict_GetItemString(cfg, "iteration_end")))
+    it_end = PyLong_AsLong(v);
+  if ((v = PyDict_GetItemString(cfg, "strict_shape")))
+    strict = PyObject_IsTrue(v);
+  if (PyErr_Occurred()) {
+    // a malformed field (e.g. iteration_end as a string) must surface as
+    // an error, not silently drop the option and predict with all trees
+    Py_DECREF(cfg);
+    return fail();
+  }
+  Py_DECREF(cfg);
+  if (type != 0 && type != 1) {
+    return fail_msg(
+        "inplace predict supports type 0 (value) and 1 (margin); use "
+        "XGBoosterPredictFromDMatrix for leaf/contribution predictions");
+  }
+  PyObject *kw = PyDict_New();
+  PyObject *args = Py_BuildValue("(O)", data);
+  PyObject *meth = PyObject_GetAttrString(w->obj, "inplace_predict");
+  if (kw == nullptr || args == nullptr || meth == nullptr) {
+    Py_XDECREF(kw);
+    Py_XDECREF(args);
+    Py_XDECREF(meth);
+    return fail();
+  }
+  PyObject *pt = PyUnicode_FromString(type == 1 ? "margin" : "value");
+  if (pt != nullptr) {
+    PyDict_SetItemString(kw, "predict_type", pt);
+    Py_DECREF(pt);
+  }
+  PyObject *ms = PyFloat_FromDouble(missing);
+  if (ms != nullptr) {
+    PyDict_SetItemString(kw, "missing", ms);
+    Py_DECREF(ms);
+  }
+  if (strict) PyDict_SetItemString(kw, "strict_shape", Py_True);
+  // pass the range through when EITHER bound is set: Python resolves
+  // end==0 to the last round, so {begin: 2, end: 0} means rounds 2..end
+  if (it_begin > 0 || it_end > 0) {
+    PyObject *rng = Py_BuildValue("(ll)", it_begin, it_end);
+    if (rng != nullptr) {
+      PyDict_SetItemString(kw, "iteration_range", rng);
+      Py_DECREF(rng);
+    }
+  }
+  if (m != nullptr) {
+    auto *mw = static_cast<MatWrap *>(m);
+    PyObject *info = PyObject_GetAttrString(mw->obj, "info");
+    PyObject *bm = info == nullptr
+                       ? nullptr
+                       : PyObject_GetAttrString(info, "base_margin");
+    if (bm != nullptr && bm != Py_None)
+      PyDict_SetItemString(kw, "base_margin", bm);
+    Py_XDECREF(bm);
+    Py_XDECREF(info);
+    PyErr_Clear();  // a metadata-less matrix is fine
+  }
+  PyObject *r = PyObject_Call(meth, args, kw);
+  Py_DECREF(meth);
+  Py_DECREF(args);
+  Py_DECREF(kw);
+  if (r == nullptr) return fail();
+  int rc = capture_pred(w, r, out_shape, out_dim, out_result);
+  Py_DECREF(r);
+  return rc;
+}
+
+}  // namespace
+
+XGB_DLL int XGBoosterPredictFromDense(BoosterHandle handle,
+                                      char const *values,
+                                      char const *c_json_config,
+                                      DMatrixHandle m,
+                                      bst_ulong const **out_shape,
+                                      bst_ulong *out_dim,
+                                      float const **out_result) {
+  // zero-copy inplace predict (c_api.cc:833): `values` is an
+  // __array_interface__ JSON over caller memory; no DMatrix is built —
+  // rows go straight into the bucketed serving predictor
+  Gil gil;
+  auto *w = static_cast<BoosterWrap *>(handle);
+  PyObject *ad = imp("xgboost_tpu.data.adapters");
+  if (ad == nullptr) return fail();
+  PyObject *arr = PyObject_CallMethod(ad, "from_array_interface", "s",
+                                      values);
+  if (arr == nullptr) return fail();
+  int rc = inplace_predict_common(w, arr, c_json_config, m, out_shape,
+                                  out_dim, out_result);
+  Py_DECREF(arr);
+  return rc;
+}
+
+XGB_DLL int XGBoosterPredictFromCSR(BoosterHandle handle,
+                                    char const *indptr, char const *indices,
+                                    char const *values, bst_ulong ncol,
+                                    char const *c_json_config,
+                                    DMatrixHandle m,
+                                    bst_ulong const **out_shape,
+                                    bst_ulong *out_dim,
+                                    float const **out_result) {
+  // CSR twin of PredictFromDense (c_api.cc:878): three array-interface
+  // JSON documents over the caller's indptr/indices/data buffers
+  Gil gil;
+  auto *w = static_cast<BoosterWrap *>(handle);
+  PyObject *ad = imp("xgboost_tpu.data.adapters");
+  if (ad == nullptr) return fail();
+  PyObject *csr = PyObject_CallMethod(
+      ad, "csr_from_array_interface", "sssK", indptr, indices, values,
+      static_cast<unsigned long long>(ncol));
+  if (csr == nullptr) return fail();
+  int rc = inplace_predict_common(w, csr, c_json_config, m, out_shape,
+                                  out_dim, out_result);
+  Py_DECREF(csr);
+  return rc;
+}
+
 XGB_DLL int XGBoosterPredictFromDMatrix(BoosterHandle handle,
                                         DMatrixHandle dmat,
                                         char const *c_json_config,
@@ -794,30 +969,7 @@ XGB_DLL int XGBoosterPredictFromDMatrix(BoosterHandle handle,
   Py_DECREF(args);
   Py_DECREF(kw);
   if (r == nullptr) return fail();
-  // capture the shape before flattening
-  PyObject *shp = PyObject_GetAttrString(r, "shape");
-  if (shp == nullptr) {
-    Py_DECREF(r);
-    return fail();
-  }
-  Py_ssize_t nd = PyTuple_Check(shp) ? PyTuple_Size(shp) : -1;
-  if (nd < 0) {
-    Py_DECREF(shp);
-    Py_DECREF(r);
-    return fail_msg("predict returned a non-array");
-  }
-  w->pred_shape.clear();
-  for (Py_ssize_t i = 0; i < nd; ++i) {
-    PyObject *dim = PyTuple_GetItem(shp, i);
-    w->pred_shape.push_back(
-        static_cast<bst_ulong>(PyLong_AsUnsignedLongLong(dim)));
-  }
-  Py_DECREF(shp);
-  int rc = np_to(r, &w->pred);
+  int rc = capture_pred(w, r, out_shape, out_dim, out_result);
   Py_DECREF(r);
-  if (rc != 0) return rc;
-  *out_shape = w->pred_shape.data();
-  *out_dim = static_cast<bst_ulong>(w->pred_shape.size());
-  *out_result = w->pred.data();
-  return 0;
+  return rc;
 }

@@ -1,0 +1,163 @@
+// Native batched forest traversal for CPU serving (the inplace-predict
+// fast path). Reference analog: src/predictor/cpu_predictor.cc — the
+// block-of-64-rows PredictBatchByBlockOfRowsKernel. The XLA gather walk
+// (predictor/__init__.py:_walk_leaves) is the right shape for
+// device-resident training-loop predicts, but XLA:CPU lowers each
+// (tree, level) step to a generic gather at ~2-3ns/element; a pointer
+// chase over the same padded SoA arrays runs an order of magnitude
+// faster, which is the whole margin a serving frontend lives on.
+//
+// Layout contract (predictor/serving.py:_HostForest): all arrays are the
+// StackedForest tensors pulled to host, C-contiguous:
+//   left/right/feature  int32  [T, N]
+//   cond                float  [T, N]  (leaf value at leaves)
+//   default_left        uint8  [T, N]
+//   tree_group          int32  [T]
+//   tree_weights        float  [T]    (DART scaling; ones otherwise)
+// Missing values are NaN and route to the default child; categorical
+// forests never take this path (the caller gates on has_cats).
+//
+// Accumulation is double per (row, group) so the result is independent of
+// tree order and within 1 ulp of the f32 ideal — the parity contract with
+// the XLA path is |diff| < 1e-5 on margins.
+//
+// Build (native/__init__.py:get_serving_lib): g++ -O3 -march=native
+//   -fopenmp (falls back to single-thread when OpenMP is unavailable).
+
+#include <cmath>
+#include <cstdint>
+
+#ifdef _OPENMP
+#include <omp.h>
+#endif
+
+extern "C" {
+
+// out[n, K] = base[n, K] + sum over trees; returns 0 on success
+int sv_predict_dense(const float *X, int64_t n, int64_t F,
+                     const int32_t *left, const int32_t *right,
+                     const int32_t *feature, const float *cond,
+                     const uint8_t *default_left, const int32_t *tree_group,
+                     const float *tree_weights, int64_t T, int64_t N,
+                     const float *base, float *out, int64_t K) {
+  if (K <= 0 || n < 0 || T < 0) return 1;
+  constexpr int64_t kBlock = 64;  // rows per block: tree tables stay in L1
+  // small batches stay single-threaded: a serving stream of tiny requests
+  // must not pay team spawn + post-region spin-wait per call (libgomp
+  // spins after parallel regions; thousands of small predicts interleaved
+  // with XLA's own thread pool oversubscribe a cgroup-throttled host)
+#ifdef _OPENMP
+#pragma omp parallel for schedule(static) if (n >= 8192)
+#endif
+  for (int64_t b = 0; b < n; b += kBlock) {
+    const int64_t hi = b + kBlock < n ? b + kBlock : n;
+    double acc[kBlock * 8];     // K <= 8 fast path; larger K heap-allocs
+    double *accp = acc;
+    double *heap = nullptr;
+    if (K > 8) {
+      heap = new double[kBlock * K];
+      accp = heap;
+    }
+    for (int64_t i = b; i < hi; ++i)
+      for (int64_t k = 0; k < K; ++k)
+        accp[(i - b) * K + k] = base[i * K + k];
+    for (int64_t t = 0; t < T; ++t) {
+      const int32_t *lc = left + t * N;
+      const int32_t *rc = right + t * N;
+      const int32_t *fi = feature + t * N;
+      const float *co = cond + t * N;
+      const uint8_t *dl = default_left + t * N;
+      const double w = tree_weights[t];
+      const int64_t g = tree_group[t];
+      for (int64_t i = b; i < hi; ++i) {
+        const float *x = X + i * F;
+        int32_t pos = 0;
+        // bounded by N: a valid tree's walk visits < N nodes, and a
+        // malformed model (cyclic children in an untrusted JSON) must
+        // terminate like the XLA walk's fixed fori_loop does
+        for (int64_t step = 0; step < N && lc[pos] != -1; ++step) {
+          const float v = x[fi[pos]];
+          const bool go_left = std::isnan(v) ? (dl[pos] != 0) : (v < co[pos]);
+          pos = go_left ? lc[pos] : rc[pos];
+        }
+        accp[(i - b) * K + g] += static_cast<double>(co[pos]) * w;
+      }
+    }
+    for (int64_t i = b; i < hi; ++i)
+      for (int64_t k = 0; k < K; ++k)
+        out[i * K + k] = static_cast<float>(accp[(i - b) * K + k]);
+    delete[] heap;
+  }
+  return 0;
+}
+
+// CSR rows: absent entries are missing (NaN semantics) without
+// densification — the zero-copy CSR serving path. indptr is int64[n+1],
+// indices int32[nnz], values float[nnz] (caller-normalized dtypes).
+// Returns 0 ok, 1 bad arguments, 2 out-of-range column index (scipy does
+// NOT bounds-check caller-built index arrays, and an unchecked index
+// would be an OOB write into the row buffer — the check lives here, next
+// to the scatter, so hot-path callers don't pre-scan the indices).
+int sv_predict_csr(const int64_t *indptr, const int32_t *indices,
+                   const float *values, int64_t n, int64_t F,
+                   const int32_t *left, const int32_t *right,
+                   const int32_t *feature, const float *cond,
+                   const uint8_t *default_left, const int32_t *tree_group,
+                   const float *tree_weights, int64_t T, int64_t N,
+                   const float *base, float *out, int64_t K) {
+  if (K <= 0 || n < 0 || T < 0) return 1;
+  const float kNaN = std::nanf("");
+  int bad_index = 0;  // benign racy writes: every writer stores 1
+#ifdef _OPENMP
+#pragma omp parallel if (n >= 8192)
+#endif
+  {
+    // Fill/Drop discipline (reference cpu_predictor.cc FVec): the row
+    // buffer is NaN-initialized ONCE per thread; after each row's walk,
+    // only the indices that row actually set are reset — O(nnz + walk)
+    // per row instead of O(F), which matters for wide one-hot matrices
+    float *row = new float[F];
+    for (int64_t f = 0; f < F; ++f) row[f] = kNaN;
+    double *acc = new double[K];
+#ifdef _OPENMP
+#pragma omp for schedule(static)
+#endif
+    for (int64_t i = 0; i < n; ++i) {
+      if (bad_index) continue;  // poisoned: result will be discarded
+      for (int64_t e = indptr[i]; e < indptr[i + 1]; ++e) {
+        const int32_t c = indices[e];
+        if (c < 0 || c >= F) {
+          bad_index = 1;
+          break;
+        }
+        row[c] = values[e];
+      }
+      if (bad_index) continue;
+      for (int64_t k = 0; k < K; ++k) acc[k] = base[i * K + k];
+      for (int64_t t = 0; t < T; ++t) {
+        const int32_t *lc = left + t * N;
+        const int32_t *rc = right + t * N;
+        const int32_t *fi = feature + t * N;
+        const float *co = cond + t * N;
+        const uint8_t *dl = default_left + t * N;
+        int32_t pos = 0;
+        for (int64_t step = 0; step < N && lc[pos] != -1; ++step) {
+          const float v = row[fi[pos]];
+          const bool go_left = std::isnan(v) ? (dl[pos] != 0) : (v < co[pos]);
+          pos = go_left ? lc[pos] : rc[pos];
+        }
+        acc[tree_group[t]] +=
+            static_cast<double>(co[pos]) * tree_weights[t];
+      }
+      for (int64_t k = 0; k < K; ++k)
+        out[i * K + k] = static_cast<float>(acc[k]);
+      for (int64_t e = indptr[i]; e < indptr[i + 1]; ++e)
+        row[indices[e]] = kNaN;  // drop: indices validated above
+    }
+    delete[] row;
+    delete[] acc;
+  }
+  return bad_index ? 2 : 0;
+}
+
+}  // extern "C"

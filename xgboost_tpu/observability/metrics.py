@@ -4,8 +4,12 @@ One process-wide registry (``REGISTRY``) holds every telemetry series —
 training progress (``rounds_total``, ``round_seconds``), tree shape
 (``tree_depth``, ``split_gain``), host-side phase timings
 (``hist_build_seconds``, ``monitor_seconds`` via the ``utils.timer.Monitor``
-adapter), and collective-comms volume (``collective_bytes_total`` — see
-``observability.comms``). Two export surfaces:
+adapter), collective-comms volume (``collective_bytes_total`` — see
+``observability.comms``), and the serving fast path's cache health
+(``predict_bucket_cache_{hits,misses,evictions}_total`` +
+``predict_bucket_cache_entries``, ``predict_forest_snapshot_*``,
+``predict_native_rows_total``, ``inplace_predict_rows_total`` — see
+``predictor/serving.py`` and docs/serving.md). Two export surfaces:
 
 - ``REGISTRY.exposition()`` — Prometheus text exposition format, ready to
   serve from a ``/metrics`` endpoint or drop into a textfile collector;

@@ -172,8 +172,29 @@ class XGBModel:
         self, X, output_margin: bool = False, validate_features: bool = True,
         base_margin=None, iteration_range: Optional[Tuple[int, int]] = None,
     ) -> np.ndarray:
+        b = self.get_booster()
+        # serving fast path (reference sklearn.py:can_use_inplace_predict):
+        # raw numpy/scipy inputs skip DMatrix construction entirely and go
+        # through the bucketed inplace predictor; anything it does not
+        # understand falls back to the DMatrix path below
+        if (
+            getattr(b._gbm, "name", None) in ("gbtree", "dart")
+            and (isinstance(X, np.ndarray) or hasattr(X, "tocsr"))
+        ):
+            try:
+                return b.inplace_predict(
+                    X, iteration_range=iteration_range,
+                    predict_type="margin" if output_margin else "value",
+                    missing=self.missing, base_margin=base_margin,
+                    validate_features=validate_features,
+                )
+            except TypeError:
+                # exotic array-likes the fast path can't digest fall back;
+                # ValueError (e.g. feature-count mismatch) must PROPAGATE —
+                # the DMatrix path would silently mispredict instead
+                pass
         d = self._make_dmatrix(X, base_margin=base_margin)
-        return self.get_booster().predict(
+        return b.predict(
             d, output_margin=output_margin, iteration_range=iteration_range
         )
 

@@ -451,7 +451,11 @@ def grow_tree_fused_paged(
     prefetched). Device memory holds ONE page of bins plus per-page row
     positions/gradients; the histogram/eval machinery is byte-identical to
     the in-core path (shared ``_level_update``/``_finalize``)."""
-    assert cfg.axis_name is None, "paged + mesh not supported yet"
+    assert cfg.axis_name is None, (
+        "paged + mesh is not supported inside one process; compose them "
+        "ACROSS processes instead — shard rows across processes (dsplit="
+        "row), page within each. Recipe: docs/serving.md, 'Composing "
+        "external memory with a mesh'.")
     assert not cfg.has_categorical
     from ..observability import trace as _trace
 
