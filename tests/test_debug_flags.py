@@ -1,0 +1,75 @@
+"""XGBTPU_DEBUG_NANS / XGBTPU_CHECK_TRACER_LEAKS opt-ins (config.py):
+the jax analog of a sanitizer lane — a seeded NaN raises at the producing
+op, a leaked tracer raises at the leak, instead of corrupting a model
+rounds later."""
+
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from xgboost_tpu.config import DEBUG_ENV_FLAGS, apply_debug_env
+
+
+@pytest.fixture
+def restore_flags():
+    saved = {flag: getattr(jax.config, flag)
+             for flag in DEBUG_ENV_FLAGS.values()}
+    yield
+    for flag, value in saved.items():
+        jax.config.update(flag, value)
+
+
+def test_unset_env_touches_nothing():
+    assert apply_debug_env({}) == {}
+
+
+def test_falsy_values_disable(restore_flags):
+    assert apply_debug_env({"XGBTPU_DEBUG_NANS": "0"}) == {
+        "jax_debug_nans": False}
+    assert apply_debug_env({"XGBTPU_DEBUG_NANS": "off"}) == {
+        "jax_debug_nans": False}
+    # case/whitespace folded: OFF / False / ' no ' all mean off
+    assert apply_debug_env({"XGBTPU_DEBUG_NANS": "OFF"}) == {
+        "jax_debug_nans": False}
+    assert apply_debug_env({"XGBTPU_DEBUG_NANS": " False "}) == {
+        "jax_debug_nans": False}
+
+
+def test_debug_nans_catches_seeded_nan(restore_flags):
+    """With the opt-in live, a NaN produced INSIDE a jitted program raises
+    FloatingPointError at the producing dispatch — the exact failure mode
+    (0/0 gradients, log of a non-positive margin) that otherwise surfaces
+    rounds later as a silently corrupt model."""
+    assert apply_debug_env({"XGBTPU_DEBUG_NANS": "1"}) == {
+        "jax_debug_nans": True}
+
+    @jax.jit
+    def seeded(x):
+        return jnp.log(x)  # log(-1) -> NaN
+
+    with pytest.raises(FloatingPointError):
+        seeded(jnp.float32(-1.0)).block_until_ready()
+
+
+def test_debug_nans_off_lets_nan_through(restore_flags):
+    apply_debug_env({"XGBTPU_DEBUG_NANS": "0"})
+    out = jax.jit(jnp.log)(jnp.float32(-1.0))
+    assert bool(jnp.isnan(out))
+
+
+def test_check_tracer_leaks_catches_leak(restore_flags):
+    """With the opt-in live, a tracer stashed outside its trace (the PR-1
+    bug class: host-side state capturing staging values) raises at the
+    leak instead of erroring cryptically on next use."""
+    assert apply_debug_env({"XGBTPU_CHECK_TRACER_LEAKS": "1"}) == {
+        "jax_check_tracer_leaks": True}
+    leaked = []
+
+    @jax.jit
+    def leaky(x):
+        leaked.append(x)  # escapes the trace
+        return x + 1
+
+    with pytest.raises(Exception, match="[Ll]eak"):
+        leaky(jnp.ones((3,)))

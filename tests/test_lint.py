@@ -1,0 +1,223 @@
+"""Static-analysis gate tests (xgboost_tpu/analysis): the package must
+lint clean against its baseline, the seeded fixture must trip EVERY rule,
+and the CLI contract (exit codes, baseline strictness) is pinned."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from xgboost_tpu.analysis.baseline import (
+    DEFAULT_BASELINE, load_baseline, write_baseline)
+from xgboost_tpu.analysis.lint import ALL_RULES, Finding, lint_paths, run_lint
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+FIXTURE = os.path.join(HERE, "fixtures", "lint_violations.py")
+
+
+# ---------------------------------------------------------------------------
+# acceptance: package green, fixture red
+# ---------------------------------------------------------------------------
+
+
+def test_package_lints_clean_against_baseline():
+    """`python -m xgboost_tpu lint` exits 0: every current finding is
+    baseline-suppressed (each with a justification) or fixed."""
+    new, suppressed, stale = run_lint(
+        None, load_baseline(DEFAULT_BASELINE))
+    assert new == [], "unsuppressed findings:\n" + "\n".join(
+        f.render() for f in new)
+    assert not stale, f"stale baseline entries: {stale}"
+    # the baseline is a ratchet, not a landfill: it must stay small
+    assert len(suppressed) < 25
+
+
+def test_baseline_entries_all_justified():
+    baseline = load_baseline(DEFAULT_BASELINE)
+    assert baseline, "package baseline should exist and be non-empty"
+    for key, why in baseline.items():
+        assert len(why) > 20, f"{key}: justification too thin: {why!r}"
+
+
+def test_fixture_trips_every_rule():
+    """One seeded violation per rule: a rule that stops firing here has
+    silently died."""
+    findings = lint_paths([FIXTURE])
+    hit = {f.rule for f in findings}
+    assert hit == set(ALL_RULES), (
+        f"rules not firing: {sorted(set(ALL_RULES) - hit)}; "
+        f"unknown rules: {sorted(hit - set(ALL_RULES))}")
+
+
+def test_cli_exit_codes():
+    """Exit 0 on the clean package, non-zero on the seeded fixture."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    ok = subprocess.run(
+        [sys.executable, "-m", "xgboost_tpu", "lint"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300)
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+    assert "lint OK" in ok.stdout
+    bad = subprocess.run(
+        [sys.executable, "-m", "xgboost_tpu", "lint", FIXTURE,
+         "--no-baseline"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300)
+    assert bad.returncode == 1, bad.stdout + bad.stderr
+    for rule in ALL_RULES:
+        assert rule in bad.stdout, f"{rule} missing from CLI output"
+
+
+# ---------------------------------------------------------------------------
+# engine behavior details
+# ---------------------------------------------------------------------------
+
+
+def test_taint_does_not_flow_through_shape(tmp_path):
+    """x.shape / len() / range() of a tracer are static: host math on them
+    inside a traced function is legal and must not be flagged."""
+    f = tmp_path / "shapes.py"
+    f.write_text(
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "import numpy as np\n"
+        "@jax.jit\n"
+        "def fn(x):\n"
+        "    n, F = x.shape\n"
+        "    width = int(np.ceil(F / 2))\n"
+        "    if F > 4:\n"
+        "        x = x[:, :4]\n"
+        "    return x * width\n")
+    assert lint_paths([str(f)]) == []
+
+
+def test_is_none_checks_not_flagged(tmp_path):
+    f = tmp_path / "optional.py"
+    f.write_text(
+        "import jax\n"
+        "@jax.jit\n"
+        "def fn(x, w=None):\n"
+        "    if w is not None:\n"
+        "        x = x * w\n"
+        "    return x\n")
+    assert [x for x in lint_paths([str(f)]) if x.rule == "TS103"] == []
+
+
+def test_static_argnames_suppress_taint(tmp_path):
+    """Params routed through static_argnames are Python values: control
+    flow and int() on them is the whole point."""
+    f = tmp_path / "statics.py"
+    f.write_text(
+        "import functools\n"
+        "import jax\n"
+        "@functools.partial(jax.jit, static_argnames=('cfg', 'depth'))\n"
+        "def fn(x, cfg, depth=3):\n"
+        "    if cfg:\n"
+        "        x = x + 1\n"
+        "    for _ in range(int(depth)):\n"
+        "        x = x * 2\n"
+        "    return x\n")
+    findings = lint_paths([str(f)])
+    assert [x for x in findings if x.rule in ("TS102", "TS103")] == []
+    # depth has a scalar default but IS static: no RH201 either
+    assert [x for x in findings if x.rule == "RH201"] == []
+
+
+def test_lock_scoped_mutation_not_flagged(tmp_path):
+    f = tmp_path / "locked.py"
+    f.write_text(
+        "import threading\n"
+        "_CACHE = {}\n"
+        "_lock = threading.Lock()\n"
+        "def put(k, v):\n"
+        "    with _lock:\n"
+        "        _CACHE[k] = v\n")
+    assert [x for x in lint_paths([str(f)]) if x.rule == "CC401"] == []
+
+
+def test_interprocedural_taint_reaches_callee(tmp_path):
+    """A helper called from a jit root with a tracer argument is traced
+    too: its violations must be caught."""
+    f = tmp_path / "interproc.py"
+    f.write_text(
+        "import jax\n"
+        "def helper(v):\n"
+        "    print('value', v)\n"
+        "    return v + 1\n"
+        "@jax.jit\n"
+        "def fn(x):\n"
+        "    return helper(x)\n")
+    findings = lint_paths([str(f)])
+    assert any(x.rule == "TS101" and x.symbol == "helper"
+               for x in findings), findings
+
+
+# ---------------------------------------------------------------------------
+# baseline format
+# ---------------------------------------------------------------------------
+
+
+def test_baseline_roundtrip_and_todo_rejection(tmp_path):
+    path = str(tmp_path / "baseline.txt")
+    findings = [
+        Finding("TS101", "pkg/a.py", 10, "fn", "msg"),
+        Finding("CC401", "pkg/b.py", 20, "g", "msg"),
+    ]
+    n = write_baseline(findings, path)
+    assert n == 2
+    # fresh entries carry TODO markers: strict loading (the gate) rejects
+    with pytest.raises(ValueError, match="justification"):
+        load_baseline(path, strict=True)
+    # annotate, then strict loading accepts and suppression works
+    text = open(path).read().replace(
+        "TODO: justify", "annotated because reasons, at length")
+    open(path, "w").write(text)
+    loaded = load_baseline(path, strict=True)
+    assert set(loaded) == {("TS101", "pkg/a.py", "fn"),
+                           ("CC401", "pkg/b.py", "g")}
+    # matching is line-number independent
+    moved = [Finding("TS101", "pkg/a.py", 999, "fn", "msg")]
+    new = [f for f in moved if f.key() not in loaded]
+    assert new == []
+
+
+def test_write_baseline_refuses_subset_scope(tmp_path):
+    """--write-baseline with explicit paths or --rules would regenerate
+    the file from a SUBSET of findings, silently dropping every other
+    entry and its justification — the CLI must refuse (exit 2)."""
+    from xgboost_tpu.analysis.cli import main as lint_main
+
+    scratch = str(tmp_path / "b.txt")
+    assert lint_main([FIXTURE, "--write-baseline",
+                      "--baseline", scratch]) == 2
+    assert lint_main(["--rules", "CC401", "--write-baseline",
+                      "--baseline", scratch]) == 2
+    assert not os.path.exists(scratch)
+
+
+def test_cli_nonexistent_path_is_an_error():
+    """A typo'd CI target must exit 2, not greenlight an empty run."""
+    from xgboost_tpu.analysis.cli import main as lint_main
+
+    assert lint_main(["no/such/dir"]) == 2
+
+
+def test_rh201_fires_on_call_site_jit(tmp_path):
+    """`g = jax.jit(f)` with a scalar-default param on f is the same
+    hazard as the decorator form and must be flagged."""
+    f = tmp_path / "callsite.py"
+    f.write_text(
+        "import jax\n"
+        "def compute(x, n=3):\n"
+        "    return x * n\n"
+        "g = jax.jit(compute)\n")
+    findings = lint_paths([str(f)])
+    assert any(x.rule == "RH201" and x.symbol == "compute"
+               for x in findings), findings
+
+
+def test_baseline_malformed_line_rejected(tmp_path):
+    p = tmp_path / "bad.txt"
+    p.write_text("TS101 | missing | fields\n")
+    with pytest.raises(ValueError, match="expected"):
+        load_baseline(str(p), strict=True)

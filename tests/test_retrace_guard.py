@@ -1,0 +1,147 @@
+"""Runtime retrace guard (xgboost_tpu/analysis/retrace.py): trace
+counting, ``recompiles_total`` export, XGBTPU_RETRACE_BUDGET enforcement —
+including the serving bucketing contract (≤ 9 compiles for 1000 ragged
+batch sizes in [1, 4096]) as a HARD invariant, not a bench observation."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import xgboost_tpu as xgb
+from xgboost_tpu.analysis.retrace import (
+    RetraceBudgetExceeded, guard_jit, reset_retrace_counts, retrace_budget,
+    retrace_counts)
+from xgboost_tpu.observability.metrics import REGISTRY
+
+
+def _metric(fn: str) -> float:
+    fam = REGISTRY.get("recompiles_total")
+    if fam is None:
+        return 0.0
+    for labels, child in fam.series():
+        if labels.get("fn") == fn:
+            return child.value
+    return 0.0
+
+
+def test_guard_counts_traces_not_calls(monkeypatch):
+    monkeypatch.delenv("XGBTPU_RETRACE_BUDGET", raising=False)
+    reset_retrace_counts("t_shape_count")
+
+    @guard_jit(name="t_shape_count")
+    def f(x):
+        return x * 2.0
+
+    before = _metric("t_shape_count")
+    f(jnp.ones((4,)))
+    f(jnp.ones((4,)))  # cache hit: no new trace
+    assert retrace_counts().get("t_shape_count") == 1
+    f(jnp.ones((8,)))  # new shape: retrace
+    f(jnp.ones((4,), jnp.int32))  # new dtype: retrace
+    assert retrace_counts().get("t_shape_count") == 3
+    assert _metric("t_shape_count") - before == 3
+
+
+def test_guard_preserves_static_argnames(monkeypatch):
+    monkeypatch.delenv("XGBTPU_RETRACE_BUDGET", raising=False)
+    reset_retrace_counts("t_statics")
+
+    @guard_jit(name="t_statics", static_argnames=("k",))
+    def f(x, k):
+        return x + k
+
+    assert float(f(jnp.ones(()), k=2)) == 3.0
+    assert float(f(jnp.ones(()), k=5)) == 6.0  # distinct static: retrace
+    assert float(f(jnp.zeros(()), k=2)) == 2.0  # cached signature
+    assert retrace_counts().get("t_statics") == 2
+
+
+def test_budget_parsing(monkeypatch):
+    monkeypatch.setenv("XGBTPU_RETRACE_BUDGET", "16")
+    assert retrace_budget("anything") == 16
+    monkeypatch.setenv("XGBTPU_RETRACE_BUDGET",
+                       "predict_serving=9,grow_tree_fused=4,*=64")
+    assert retrace_budget("predict_serving") == 9
+    assert retrace_budget("grow_tree_fused") == 4
+    assert retrace_budget("other") == 64
+    monkeypatch.setenv("XGBTPU_RETRACE_BUDGET", "predict_serving=9")
+    assert retrace_budget("other") is None  # no default: count-only
+    monkeypatch.setenv("XGBTPU_RETRACE_BUDGET", "garbage=,,=3")
+    assert retrace_budget("x") is None  # malformed: never breaks training
+    monkeypatch.delenv("XGBTPU_RETRACE_BUDGET")
+    assert retrace_budget("x") is None
+
+
+def test_budget_enforced_on_guarded_fn(monkeypatch):
+    monkeypatch.setenv("XGBTPU_RETRACE_BUDGET", "t_budget=2")
+    reset_retrace_counts("t_budget")
+
+    @guard_jit(name="t_budget")
+    def f(x):
+        return x + 1.0
+
+    f(jnp.ones((2,)))
+    f(jnp.ones((3,)))
+    with pytest.raises(RetraceBudgetExceeded, match="t_budget"):
+        f(jnp.ones((5,)))
+
+
+def _train_small(n_features: int, rounds: int = 2):
+    rng = np.random.RandomState(7)
+    X = rng.rand(256, n_features).astype(np.float32)
+    y = (X[:, 0] * 2 + X[:, 1] > 1.2).astype(np.float32)
+    d = xgb.DMatrix(X, label=y)
+    return xgb.train(
+        {"max_depth": 2, "objective": "binary:logistic",
+         "tree_method": "tpu_hist", "base_score": 0.5},
+        d, num_boost_round=rounds)
+
+
+def test_serving_bucket_bound_enforced(monkeypatch):
+    """The PR-2 claim — 1000 ragged batch sizes in [1, 4096] compile at
+    most 9 serving programs — enforced THROUGH the retrace budget: the
+    whole stream runs with XGBTPU_RETRACE_BUDGET=predict_serving=9 live,
+    so a 10th compile would raise, not just show up in a counter."""
+    monkeypatch.setenv("XGBTPU_NATIVE_SERVING", "0")  # force bucket path
+    bst = _train_small(n_features=11)
+    rng = np.random.RandomState(3)
+    sizes = rng.randint(1, 4097, size=1000)
+    reset_retrace_counts("predict_serving")
+    monkeypatch.setenv("XGBTPU_RETRACE_BUDGET", "predict_serving=9")
+    X = rng.rand(4096, 11).astype(np.float32)
+    for n in sizes:
+        out = bst.inplace_predict(X[:n], predict_type="margin")
+        assert out.shape[0] == n
+    compiles = retrace_counts().get("predict_serving", 0)
+    assert 0 < compiles <= 9, compiles
+    # the registry series agrees with the host-side count's delta shape
+    assert _metric("predict_serving") >= compiles
+
+
+def test_serving_budget_trips_on_bucket_overflow(monkeypatch):
+    """Same mechanism, proving enforcement is real: a budget below the
+    stream's bucket count raises RetraceBudgetExceeded mid-stream."""
+    monkeypatch.setenv("XGBTPU_NATIVE_SERVING", "0")
+    bst = _train_small(n_features=13)  # distinct forest sig: fresh keys
+    reset_retrace_counts("predict_serving")
+    monkeypatch.setenv("XGBTPU_RETRACE_BUDGET", "predict_serving=3")
+    rng = np.random.RandomState(5)
+    X = rng.rand(4096, 13).astype(np.float32)
+    with pytest.raises(RetraceBudgetExceeded, match="predict_serving"):
+        for n in (1, 20, 40, 100, 300, 700, 1500, 3000):  # 8 buckets
+            bst.inplace_predict(X[:n], predict_type="margin")
+
+
+def test_grow_budget_allows_normal_training(monkeypatch):
+    """A sane training budget (one signature per grower entry) does not
+    fire across repeated same-shape fits; the counters still move."""
+    reset_retrace_counts()
+    monkeypatch.setenv("XGBTPU_RETRACE_BUDGET", "*=32")
+    _train_small(n_features=9, rounds=3)
+    counts = retrace_counts()
+    assert counts.get("grow_tree_fused", 0) >= 1
+    # eta/gamma are traced scalars and cfg is static: 3 rounds of the
+    # same shape must reuse ONE grow program (the PR-1 design invariant)
+    assert counts["grow_tree_fused"] == 1, counts

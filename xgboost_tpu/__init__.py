@@ -10,6 +10,11 @@ place of rabit/NCCL AllReduce.
 
 from . import _compat  # noqa: F401  (pre-0.5 jax shims; must patch first)
 from .config import config_context, get_config, set_config  # noqa: F401
+from .config import apply_debug_env as _apply_debug_env
+
+# debug opt-ins (XGBTPU_DEBUG_NANS / XGBTPU_CHECK_TRACER_LEAKS -> jax
+# debug flags) applied before any jit is built — docs/static_analysis.md
+_apply_debug_env()
 from .data.dmatrix import DMatrix, QuantileDMatrix, load_row_split  # noqa: F401
 from .utils.timer import profiler_context  # noqa: F401
 from .data.external import ExternalMemoryQuantileDMatrix  # noqa: F401

@@ -1,0 +1,30 @@
+"""Static analysis + runtime guards for the JAX/TPU core.
+
+The reference C++ core gets its safety net from the toolchain
+(warnings-as-errors, ASan/UBSan CI lanes); this package is the analog for
+a Python/JAX tree-boosting core, where the two recurring bug classes are
+host-side Python leaking into jit staging (tracer coercion, host I/O at
+trace time) and silent XLA recompile churn (non-static scalars, ragged
+shapes). Two halves:
+
+- **static**: an AST lint engine (``lint.py``) with four passes —
+  trace-safety, retrace-hygiene, dtype/precision, concurrency — run via
+  ``python -m xgboost_tpu lint`` (``cli.py``), gated in CI against a
+  checked-in baseline suppression file (``baseline.py`` /
+  ``lint_baseline.txt``);
+- **runtime**: a retrace detector (``retrace.py``) wrapping the hot jit
+  entry points, exporting ``recompiles_total{fn=...}`` to the metrics
+  registry and enforcing ``XGBTPU_RETRACE_BUDGET`` as a hard invariant.
+
+Rule catalog and usage: ``docs/static_analysis.md``.
+"""
+
+from .lint import Finding, lint_paths, run_lint  # noqa: F401
+from .baseline import load_baseline, write_baseline  # noqa: F401
+from .retrace import (  # noqa: F401
+    RetraceBudgetExceeded,
+    guard_jit,
+    note_retrace,
+    retrace_counts,
+    reset_retrace_counts,
+)

@@ -1,0 +1,94 @@
+"""Baseline suppression file: the ratchet that lets the lint gate start
+green on a codebase with known (justified) findings and only ever get
+stricter.
+
+Format — one entry per line, pipe-separated, ``#`` comments::
+
+    RULE | path | symbol | justification
+
+Entries match on ``(rule, path, symbol)`` — NOT on line numbers, so
+unrelated edits above a suppressed site don't invalidate the baseline.
+``symbol`` is the enclosing function's qualified name (``Class.method``,
+``outer.inner``) or ``<module>``. Every entry **must** carry a
+justification; loading rejects entries without one — a suppression nobody
+can explain is a bug waiting to be un-found.
+
+The checked-in package baseline lives next to this module
+(``lint_baseline.txt``); ``python -m xgboost_tpu lint --write-baseline``
+regenerates it from current findings (justifications of surviving entries
+are preserved; new entries get a ``TODO: justify`` marker that the gate
+refuses to accept, forcing a human to annotate)."""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Tuple
+
+__all__ = ["DEFAULT_BASELINE", "load_baseline", "write_baseline"]
+
+DEFAULT_BASELINE = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "lint_baseline.txt")
+
+_TODO = "TODO: justify"
+
+Key = Tuple[str, str, str]
+
+
+def load_baseline(path: str = DEFAULT_BASELINE,
+                  strict: bool = True) -> Dict[Key, str]:
+    """Parse a baseline file -> {(rule, path, symbol): justification}.
+    With ``strict`` (the default, used by the CI gate), malformed lines,
+    empty justifications, and ``TODO`` markers raise ``ValueError``."""
+    out: Dict[Key, str] = {}
+    if not os.path.exists(path):
+        return out
+    with open(path, encoding="utf-8") as f:
+        for ln, line in enumerate(f, 1):
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            parts = [p.strip() for p in line.split("|")]
+            if len(parts) != 4:
+                if strict:
+                    raise ValueError(
+                        f"{path}:{ln}: expected 'RULE | path | symbol | "
+                        f"justification', got {line!r}")
+                continue
+            rule, relpath, symbol, why = parts
+            if strict and (not why or why.startswith(_TODO)):
+                raise ValueError(
+                    f"{path}:{ln}: baseline entry {rule} {relpath} "
+                    f"[{symbol}] has no justification — annotate it "
+                    f"before the gate will accept it")
+            out[(rule, relpath, symbol)] = why
+    return out
+
+
+def write_baseline(findings, path: str = DEFAULT_BASELINE) -> int:
+    """Write a baseline covering ``findings``. Justifications of entries
+    already present in the existing file are carried over; genuinely new
+    entries get a ``TODO: justify`` marker (which strict loading rejects —
+    the ratchet forces annotation, not silent growth). Returns the number
+    of entries written."""
+    old = load_baseline(path, strict=False)
+    keys: List[Key] = []
+    seen = set()
+    for f in findings:
+        k = f.key()
+        if k not in seen:
+            seen.add(k)
+            keys.append(k)
+    lines = [
+        "# xgboost_tpu lint baseline — format: RULE | path | symbol | "
+        "justification",
+        "# Matches on (rule, path, symbol); line numbers are irrelevant.",
+        "# Every entry needs a human-written justification: the gate",
+        "# rejects 'TODO: justify' markers left by --write-baseline.",
+        "",
+    ]
+    for k in sorted(keys):
+        why = old.get(k, _TODO)
+        lines.append(" | ".join(k + (why,)))
+    with open(path, "w", encoding="utf-8") as f:
+        f.write("\n".join(lines) + "\n")
+    return len(keys)

@@ -1,0 +1,133 @@
+"""``python -m xgboost_tpu lint`` — the static-analysis gate.
+
+Exit status: 0 when every finding is covered by the baseline, 1 when any
+unsuppressed finding remains (CI fails), 2 on usage/baseline-format
+errors. See ``docs/static_analysis.md`` for the rule catalog."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from .baseline import DEFAULT_BASELINE, load_baseline, write_baseline
+from .lint import ALL_RULES, lint_paths, run_lint
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m xgboost_tpu lint",
+        description="trace-safety / retrace / dtype / concurrency lint",
+    )
+    p.add_argument("paths", nargs="*",
+                   help="files or directories (default: the xgboost_tpu "
+                        "package)")
+    p.add_argument("--baseline", default=DEFAULT_BASELINE,
+                   help="suppression file (default: the checked-in "
+                        "package baseline)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore the baseline: report every finding")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="rewrite the baseline to cover current findings "
+                        "(new entries get a TODO marker the gate rejects "
+                        "until annotated)")
+    p.add_argument("--rules",
+                   help="comma-separated rule ids to run (default: all)")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--show-suppressed", action="store_true",
+                   help="also list baseline-suppressed findings")
+    p.add_argument("--list-rules", action="store_true")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        for rid, desc in sorted(ALL_RULES.items()):
+            print(f"{rid}  {desc}")
+        return 0
+    rules = None
+    if args.rules:
+        rules = {r.strip() for r in args.rules.split(",") if r.strip()}
+        unknown = rules - set(ALL_RULES)
+        if unknown:
+            print(f"unknown rules: {', '.join(sorted(unknown))}",
+                  file=sys.stderr)
+            return 2
+
+    if args.write_baseline:
+        if args.paths or rules:
+            # a subset run sees a subset of findings: regenerating from it
+            # would silently DROP every entry (and hand-written
+            # justification) outside the subset
+            print("--write-baseline regenerates the whole file and only "
+                  "composes with a full-package run: drop the explicit "
+                  "paths/--rules", file=sys.stderr)
+            return 2
+        findings = lint_paths(None, None)
+        n = write_baseline(findings, args.baseline)
+        print(f"wrote {n} baseline entries to {args.baseline}")
+        print("annotate any 'TODO: justify' markers — the gate rejects "
+              "them")
+        return 0
+
+    import os
+
+    missing = [p for p in (args.paths or []) if not os.path.exists(p)]
+    if missing:
+        # a typo'd CI target must fail loudly, not greenlight an empty run
+        print(f"no such path: {', '.join(missing)}", file=sys.stderr)
+        return 2
+    if args.paths:
+        from .lint import iter_python_files
+
+        if not iter_python_files(args.paths):
+            # same trap, existing path: a dir of .cpp files (or one .cpp
+            # target) lints NOTHING and must not report a clean gate
+            print(f"no Python files under: {', '.join(args.paths)}",
+                  file=sys.stderr)
+            return 2
+
+    try:
+        baseline = {} if args.no_baseline else load_baseline(args.baseline)
+    except ValueError as e:
+        print(f"baseline error: {e}", file=sys.stderr)
+        return 2
+
+    new, suppressed, stale = run_lint(args.paths or None, baseline, rules)
+    if args.paths or rules:
+        # subset runs see a subset of findings: entries outside the subset
+        # are invisible, not stale — reporting them would invite pruning
+        # suppressions the full gate still needs
+        stale = []
+
+    if args.format == "json":
+        print(json.dumps({
+            "findings": [f.__dict__ for f in new],
+            "suppressed": [f.__dict__ for f in suppressed],
+            "stale_baseline": [list(k) for k in stale],
+        }, indent=2))
+        return 1 if new else 0
+
+    for f in new:
+        print(f.render())
+    if args.show_suppressed:
+        for f in suppressed:
+            print(f"[suppressed] {f.render()}")
+    if stale:
+        print(f"note: {len(stale)} stale baseline entr"
+              f"{'y' if len(stale) == 1 else 'ies'} no longer match "
+              f"anything — prune them:", file=sys.stderr)
+        for k in stale:
+            print(f"  {' | '.join(k)}", file=sys.stderr)
+    if new:
+        print(f"\n{len(new)} unsuppressed finding"
+              f"{'' if len(new) == 1 else 's'} "
+              f"({len(suppressed)} baseline-suppressed). "
+              f"Fix them, or baseline WITH justification "
+              f"(--write-baseline, then annotate).", file=sys.stderr)
+        return 1
+    print(f"lint OK: 0 unsuppressed findings "
+          f"({len(suppressed)} baseline-suppressed)")
+    return 0

@@ -11,6 +11,10 @@ model_out, model_dir, num_round, save_period, eval[name]=path, dump_format,
 name_pred, plus any booster/learner parameters. ``trace-report``
 summarizes a Chrome trace-event file written via ``XGBTPU_TRACE`` (top
 spans by self time, per-rank totals — ``docs/observability.md``).
+``lint`` runs the static-analysis gate (trace-safety / retrace / dtype /
+concurrency passes, ``docs/static_analysis.md``):
+
+    python -m xgboost_tpu lint [paths...] [--baseline F] [--write-baseline]
 """
 
 from __future__ import annotations
@@ -72,6 +76,10 @@ def cli_main(argv: List[str]) -> int:
         from .observability.report import main as report_main
 
         return report_main(argv[1:])
+    if argv[0] == "lint":
+        from .analysis.cli import main as lint_main
+
+        return lint_main(argv[1:])
     pairs = parse_config_file(argv[0])
     for extra in argv[1:]:
         k, _, v = extra.partition("=")

@@ -8,8 +8,9 @@ TPU-native analog of the reference's ``GlobalConfiguration``
 from __future__ import annotations
 
 import contextlib
+import os
 import threading
-from typing import Any, Dict, Iterator
+from typing import Any, Dict, Iterator, Mapping, Optional
 
 _DEFAULTS: Dict[str, Any] = {
     "verbosity": 1,
@@ -53,3 +54,44 @@ def config_context(**kwargs: Any) -> Iterator[None]:
         yield
     finally:
         _state().update(saved)
+
+
+# ---------------------------------------------------------------------------
+# debug opt-ins: env vars -> jax.config flags (the jax analog of the
+# reference's sanitizer builds — see docs/static_analysis.md)
+# ---------------------------------------------------------------------------
+
+#: env var -> jax.config flag. XGBTPU_DEBUG_NANS makes any NaN produced
+#: inside a jitted program raise FloatingPointError at the producing op
+#: (instead of surfacing rounds later as a corrupt model);
+#: XGBTPU_CHECK_TRACER_LEAKS makes a tracer escaping its trace (stashed in
+#: a module global, returned through a callback) raise at the leak site
+#: instead of erroring cryptically on next use.
+DEBUG_ENV_FLAGS: Dict[str, str] = {
+    "XGBTPU_DEBUG_NANS": "jax_debug_nans",
+    "XGBTPU_CHECK_TRACER_LEAKS": "jax_check_tracer_leaks",
+}
+
+_FALSY = ("", "0", "false", "no", "off")  # compared case/space-folded
+
+
+def apply_debug_env(
+        environ: Optional[Mapping[str, str]] = None) -> Dict[str, bool]:
+    """Map ``XGBTPU_DEBUG_NANS`` / ``XGBTPU_CHECK_TRACER_LEAKS`` onto
+    ``jax.config``. Called once at package import (so the env var is the
+    only thing a debugging session needs to set) and callable directly by
+    tests with an explicit ``environ``. Returns {flag: value} for every
+    flag it touched — flags whose env var is unset are left alone, so the
+    opt-in never fights an explicit ``jax.config.update`` elsewhere."""
+    env = os.environ if environ is None else environ
+    touched: Dict[str, bool] = {}
+    for var, flag in DEBUG_ENV_FLAGS.items():
+        raw = env.get(var)
+        if raw is None:
+            continue
+        value = raw.strip().lower() not in _FALSY
+        import jax
+
+        jax.config.update(flag, value)
+        touched[flag] = value
+    return touched

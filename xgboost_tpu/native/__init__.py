@@ -29,14 +29,57 @@ _pc_lib: Optional[ctypes.CDLL] = None
 _pc_tried = False
 
 
+def _san_enabled() -> bool:
+    """Sanitizer lane (the reference's CMake ``USE_SANITIZER`` analog):
+    ``XGBTPU_SAN=1`` builds every native library with ASan+UBSan and
+    warnings-as-errors, into separate ``.san.so`` artifacts so the lane
+    never clobbers (or reuses) production builds. A sanitized library only
+    *loads* under an ASan-preloaded process (``LD_PRELOAD=libasan.so``) —
+    plain processes get the usual graceful None fallback. See
+    ``tests/test_sanitizer.py`` and docs/static_analysis.md."""
+    return os.environ.get("XGBTPU_SAN") == "1"
+
+
+_SAN_FLAGS = [
+    "-fsanitize=address,undefined", "-fno-sanitize-recover=all",
+    "-fno-omit-frame-pointer", "-g", "-Wall", "-Wextra", "-Werror",
+]
+
+
+def _lib_variant(lib_path: str) -> str:
+    """The artifact path for the active lane (``.san.so`` under
+    ``XGBTPU_SAN=1``). Single source of truth for builders AND loaders."""
+    if _san_enabled() and lib_path.endswith(".so"):
+        return lib_path[:-3] + ".san.so"
+    return lib_path
+
+
+def find_libasan() -> Optional[str]:
+    """Path of the toolchain's libasan runtime (for ``LD_PRELOAD`` when
+    running a sanitized library under an uninstrumented Python), or None
+    when the toolchain can't say."""
+    try:
+        out = subprocess.run(
+            ["g++", "-print-file-name=libasan.so"],
+            capture_output=True, timeout=30, check=True,
+        ).stdout.decode().strip()
+    except Exception:
+        return None
+    return out if out and os.path.sep in out else None
+
+
 def _compile(src: str, lib_path: str, extra: list, timeout: int = 120) -> bool:
     """Build ``lib_path`` from ``src`` when stale (single-sourced
-    staleness + existence logic for all three on-demand libraries).
-    True when a usable library exists afterwards."""
+    staleness + existence logic for all the on-demand libraries).
+    True when a usable library exists afterwards. Under ``XGBTPU_SAN=1``
+    the caller passes a ``.san.so`` path (via ``_lib_variant``) and the
+    sanitizer/warning flags are appended here."""
     if not os.path.exists(src):
         return os.path.exists(lib_path)  # prebuilt-only deployment
     if os.path.exists(lib_path) and             os.path.getmtime(lib_path) >= os.path.getmtime(src):
         return True
+    if _san_enabled():
+        extra = list(extra) + _SAN_FLAGS
     cmd = ["g++", "-shared", "-fPIC", "-o", lib_path, src] + extra
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=timeout)
@@ -53,11 +96,12 @@ def get_pagecache_lib() -> Optional[ctypes.CDLL]:
         if _pc_lib is not None or _pc_tried:
             return _pc_lib
         _pc_tried = True
-        if not _compile(_PC_SRC, _PC_LIB,
+        lp = _lib_variant(_PC_LIB)
+        if not _compile(_PC_SRC, lp,
                         ["-O3", "-std=c++17", "-pthread"]):
             return None
         try:
-            lib = ctypes.CDLL(_PC_LIB)
+            lib = ctypes.CDLL(lp)
         except OSError:
             return None
         lib.pc_write.argtypes = [ctypes.c_char_p, ctypes.c_void_p,
@@ -83,10 +127,11 @@ def get_lib() -> Optional[ctypes.CDLL]:
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        if not _compile(_SRC, _LIB_PATH, ["-O3", "-march=native"]):
+        lp = _lib_variant(_LIB_PATH)
+        if not _compile(_SRC, lp, ["-O3", "-march=native"]):
             return None
         try:
-            lib = ctypes.CDLL(_LIB_PATH)
+            lib = ctypes.CDLL(lp)
         except OSError:
             return None
         lib.fp_libsvm_dims.argtypes = [
@@ -184,14 +229,15 @@ def get_serving_lib() -> Optional[ctypes.CDLL]:
         if _sv_lib is not None or _sv_tried:
             return _sv_lib
         _sv_tried = True
-        ok = _compile(_SV_SRC, _SV_LIB,
+        lp = _lib_variant(_SV_LIB)
+        ok = _compile(_SV_SRC, lp,
                       ["-O3", "-march=native", "-fopenmp"])
         if not ok:  # toolchains without OpenMP: single-threaded walker
-            ok = _compile(_SV_SRC, _SV_LIB, ["-O3", "-march=native"])
+            ok = _compile(_SV_SRC, lp, ["-O3", "-march=native"])
         if not ok:
             return None
         try:
-            lib = ctypes.CDLL(_SV_LIB)
+            lib = ctypes.CDLL(lp)
         except OSError:
             return None
         c = ctypes
@@ -239,7 +285,8 @@ def build_capi() -> Optional[str]:
         libdir = sysconfig.get_config_var("LIBDIR") or ""
         pyver = sysconfig.get_config_var("LDVERSION") or \
             sysconfig.get_config_var("VERSION") or ""
-        if not _compile(_CAPI_SRC, _CAPI_LIB,
+        lp = _lib_variant(_CAPI_LIB)
+        if not _compile(_CAPI_SRC, lp,
                         ["-O2", "-std=c++17", f"-I{inc}",
                          f'-DXGBTPU_ROOT="{repo_root}"',
                          f'-DXGBTPU_SITE="{site}"',
@@ -247,5 +294,5 @@ def build_capi() -> Optional[str]:
                          f"-Wl,-rpath,{libdir}", "-ldl", "-lm"],
                         timeout=180):
             return None
-        _capi_path = _CAPI_LIB if os.path.exists(_CAPI_LIB) else None
+        _capi_path = lp if os.path.exists(lp) else None
         return _capi_path

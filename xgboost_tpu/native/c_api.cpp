@@ -112,12 +112,14 @@ int fail_msg(const char *msg) {
 PyObject *imp(const char *name) { return PyImport_ImportModule(name); }
 
 struct MatWrap {
+  explicit MatWrap(PyObject *o) : obj(o) {}
   PyObject *obj;  // xgboost_tpu.DMatrix
   std::vector<float> finfo;  // GetFloatInfo out-buffer
   std::vector<unsigned> uinfo;  // GetUIntInfo out-buffer
 };
 
 struct BoosterWrap {
+  explicit BoosterWrap(PyObject *o) : obj(o) {}
   PyObject *obj;  // xgboost_tpu.Booster
   std::vector<float> pred;  // XGBoosterPredict out-buffer
   std::string eval_out;     // XGBoosterEvalOneIter out-string
@@ -128,14 +130,6 @@ struct BoosterWrap {
   std::vector<const char *> dump_ptrs;
 };
 
-// call a method with an already-built args tuple; returns new ref or null
-PyObject *call(PyObject *o, const char *meth, PyObject *args) {
-  PyObject *m = PyObject_GetAttrString(o, meth);
-  if (m == nullptr) return nullptr;
-  PyObject *r = PyObject_CallObject(m, args);
-  Py_DECREF(m);
-  return r;
-}
 
 // float buffer -> numpy float32 array (copy), shaped [n] or [rows, cols]
 PyObject *np_from(const float *data, bst_ulong n, bst_ulong rows = 0,
@@ -270,7 +264,7 @@ XGB_DLL int XGDMatrixCreateFromMat(const float *data, bst_ulong nrow,
   PyObject *d = PyObject_CallMethod(mod, "DMatrix", "O", arr);
   Py_DECREF(arr);
   if (d == nullptr) return fail();
-  auto *w = new MatWrap{d, {}};
+  auto *w = new MatWrap(d);
   *out = w;
   return 0;
 }
@@ -282,7 +276,7 @@ XGB_DLL int XGDMatrixCreateFromFile(const char *fname, int /*silent*/,
   if (mod == nullptr) return fail();
   PyObject *d = PyObject_CallMethod(mod, "DMatrix", "s", fname);
   if (d == nullptr) return fail();
-  *out = new MatWrap{d, {}};
+  *out = new MatWrap(d);
   return 0;
 }
 
@@ -426,7 +420,7 @@ XGB_DLL int XGDMatrixCreateFromCSREx(const size_t *indptr,
   d = PyObject_CallMethod(mod, "DMatrix", "O", csr);
   Py_DECREF(csr);
   if (d == nullptr) return fail();
-  *out = new MatWrap{d, {}};
+  *out = new MatWrap(d);
   return 0;
 }
 
@@ -449,7 +443,7 @@ XGB_DLL int XGBoosterCreate(const DMatrixHandle dmats[], bst_ulong len,
   Py_DECREF(params);
   Py_DECREF(cache);
   if (b == nullptr) return fail();
-  *out = new BoosterWrap{b, {}, {}, {}};
+  *out = new BoosterWrap(b);
   return 0;
 }
 

@@ -92,16 +92,20 @@ def _host_side() -> bool:
 
 
 def _rank_world() -> tuple:
+    # lock-guarded (lint CC402): resolving the rank can initialize the JAX
+    # backend; two flushing threads racing the latch would both pay that
+    # (and one could read a half-initialized backend)
     global _rank_cache
-    if _rank_cache is None:
-        try:
-            jax = sys.modules.get("jax")
-            if jax is None:
-                raise RuntimeError("jax not imported")
-            _rank_cache = (jax.process_index(), jax.process_count())
-        except Exception:
-            _rank_cache = (0, 1)
-    return _rank_cache
+    with _lock:
+        if _rank_cache is None:
+            try:
+                jax = sys.modules.get("jax")
+                if jax is None:
+                    raise RuntimeError("jax not imported")
+                _rank_cache = (jax.process_index(), jax.process_count())
+            except Exception:
+                _rank_cache = (0, 1)
+        return _rank_cache
 
 
 def _tid() -> int:

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import functools
 import os
+import threading
 from functools import partial
 from typing import NamedTuple, Optional, Tuple
 
@@ -209,7 +210,11 @@ _PRED_TAB_VMEM = 4 * 1024 * 1024  # byte budget for the [T, N, 8] table
 # skipped attempts the shape gets ONE retry — a transiently misclassified
 # failure (e.g. a relay error whose message happened to contain "vmem")
 # is no longer blacklisted for the life of the process (VERDICT weak #7).
+# Lock-guarded (lint CC401): concurrent predicts share the countdown, and
+# an unguarded read-decrement-write pair loses decrements — which silently
+# STRETCHES the blacklist window under serving concurrency.
 _pallas_pred_broken: dict = {}
+_pallas_pred_lock = threading.Lock()
 
 try:
     _PALLAS_RETRY_AFTER = max(
@@ -223,16 +228,15 @@ def _pallas_shape_blocked(key: tuple) -> bool:
     Each skipped attempt decrements the countdown; at zero the key is
     dropped so the NEXT call retries the pallas compile (re-blacklisting on
     a repeat failure)."""
-    left = _pallas_pred_broken.get(key)
-    if left is None:
-        return False
-    if left <= 1:
-        # pop (not del): concurrent predicts may race the same exhausted
-        # countdown — losing the race just means one extra skip
-        _pallas_pred_broken.pop(key, None)
+    with _pallas_pred_lock:
+        left = _pallas_pred_broken.get(key)
+        if left is None:
+            return False
+        if left <= 1:
+            _pallas_pred_broken.pop(key, None)
+            return True
+        _pallas_pred_broken[key] = left - 1
         return True
-    _pallas_pred_broken[key] = left - 1
-    return True
 
 
 def _pred_kernel(x_ref, tab_ref, ohg_ref, out_ref, *, T, Np, F, G, steps):
@@ -412,7 +416,8 @@ def predict_margin(
             ) or any(t in str(e).lower() for t in ("vmem", "mosaic"))
             if permanent:
                 key = (T, Np, forest.max_depth, X.shape[1], forest.n_groups)
-                _pallas_pred_broken[key] = _PALLAS_RETRY_AFTER
+                with _pallas_pred_lock:
+                    _pallas_pred_broken[key] = _PALLAS_RETRY_AFTER
                 console_logger.warning(
                     f"pallas predictor disabled for forest shape {key} "
                     f"(retry after {_PALLAS_RETRY_AFTER} predicts): "

@@ -9,8 +9,10 @@ the TPU relay). This module is the layer a serving frontend sits on:
 - **row bucketing** — batch rows pad up to a power-of-two bucket (min 16,
   capped at 8192; beyond the cap, buckets are multiples of 8192 so huge
   batches don't pay up-to-2x padding). A stream of arbitrary sizes in
-  [1, 4096] touches at most 10 buckets, so at most 10 compiles per
-  (forest-shape, output-kind) — the compile amortizes across the stream.
+  [1, 4096] touches at most 9 buckets (16, 32, ..., 4096), so at most 9
+  compiles per (forest-shape, output-kind) — the compile amortizes across
+  the stream, and the bound is enforceable via
+  ``XGBTPU_RETRACE_BUDGET=predict_serving=9`` (docs/static_analysis.md).
   Padding rows are NaN: they walk default directions and are sliced off on
   the host, never re-dispatched.
 - **compiled-program cache** — one ``jax.jit`` wrapper per (bucket,
@@ -44,6 +46,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..analysis.retrace import guard_jit, note_retrace
 from ..observability import REGISTRY as _REGISTRY
 from . import StackedForest, _predict_margin_impl, predict_margin
 
@@ -90,7 +93,12 @@ def _build_program(n_groups: int, max_depth: int, has_cats: bool,
                    transform: Optional[Callable]) -> Callable:
     """A fresh jit wrapper computing margins (and optionally the fused
     output transform) for one cache entry. The wrapper owns its executable:
-    dropping the entry releases the compiled program."""
+    dropping the entry releases the compiled program. Retrace-guarded as
+    ``predict_serving``: every build traces exactly once, so
+    ``recompiles_total{fn="predict_serving"}`` counts serving compiles and
+    ``XGBTPU_RETRACE_BUDGET=predict_serving=N`` turns the bucketing
+    contract (9 buckets cover any stream in [1, 4096]) into a hard
+    invariant instead of a bench observation."""
 
     def run(X, left, right, feature, cond, default_left, split_type,
             cat_bits, tree_group, tw, base):
@@ -102,7 +110,7 @@ def _build_program(n_groups: int, max_depth: int, has_cats: bool,
             return margin
         return transform(margin[:, 0] if n_groups == 1 else margin)
 
-    return jax.jit(run)
+    return guard_jit(run, name="predict_serving")
 
 
 class ServingCache:
@@ -172,6 +180,15 @@ class ServingCache:
 #: process-wide cache shared by every Booster (programs are keyed on forest
 #: SHAPE, not identity, so same-shaped models share compiles)
 SERVING_CACHE = ServingCache()
+
+#: pallas-route serving keys already counted in recompiles_total: the cache
+#: entry there is a thin closure over the shared ``predict_margin``
+#: dispatcher, so an LRU-evicted key that is re-touched (or a build race
+#: losing to another thread) rebuilds the closure WITHOUT any XLA compile —
+#: counting those would overcount and spuriously trip the retrace budget.
+#: One count per key per process matches the dispatcher's own jit cache.
+_PALLAS_COUNTED: set = set()
+_PALLAS_COUNTED_LOCK = threading.Lock()
 
 
 # ---------------------------------------------------------------------------
@@ -406,6 +423,19 @@ def predict_serving(
         # on shape, and a same-shaped refreshed model must not read stale
         # trees out of a closure.
         def build():
+            # the pallas route compiles inside predict_margin's own jits,
+            # so count the build here to keep recompiles_total{fn=
+            # "predict_serving"} == serving program builds on BOTH routes
+            # (and the retrace budget enforcing bucketing on both) —
+            # first touch of a key only: closure rebuilds are not compiles.
+            # The key is marked AFTER note_retrace returns: an over-budget
+            # raise leaves it unmarked, so a retried predict re-raises
+            # instead of silently slipping past enforcement.
+            with _PALLAS_COUNTED_LOCK:
+                if key not in _PALLAS_COUNTED:
+                    note_retrace("predict_serving")
+                    _PALLAS_COUNTED.add(key)
+
             def run_shared(fr, Xp, bp, tw):
                 m = predict_margin(fr, jnp.asarray(Xp), jnp.asarray(bp), tw)
                 if transform is None:

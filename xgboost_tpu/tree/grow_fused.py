@@ -29,13 +29,13 @@ replicated arithmetic on identical inputs.
 
 from __future__ import annotations
 
-import functools
 from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..analysis.retrace import guard_jit
 from .grow import (
     GrowParams,
     _sample_features_exact,
@@ -332,7 +332,7 @@ def grow_tree_fused(
                                      onehot)
 
 
-@functools.partial(jax.jit, static_argnames=("cfg",))
+@guard_jit(name="grow_tree_fused", static_argnames=("cfg",))
 def _grow_tree_fused_impl(
     bins: jax.Array,
     grad: jax.Array,
@@ -420,13 +420,18 @@ def _pallas_flag(cfg: GrowParams) -> bool:
 
 
 # jitted views of the shared level machinery for the paged (out-of-core)
-# driver, which runs the level loop in Python so pages can stream from disk
-_level_update_jit = jax.jit(_level_update, static_argnames=("cfg", "d"))
-_finalize_jit = jax.jit(_finalize, static_argnames=("cfg",))
+# driver, which runs the level loop in Python so pages can stream from disk.
+# Retrace-guarded: these recompile per level width by design (K is static),
+# so their budget is the level count, not 1 — the guard makes any EXTRA
+# recompile (e.g. a non-static scalar sneaking in) visible and budgetable.
+_level_update_jit = guard_jit(_level_update, name="level_update",
+                              static_argnames=("cfg", "d"))
+_finalize_jit = guard_jit(_finalize, name="finalize",
+                          static_argnames=("cfg",))
 
 
-@functools.partial(jax.jit, static_argnames=("Kp", "B", "d", "pallas",
-                                             "pad_nodes"))
+@guard_jit(name="page_delta", static_argnames=("Kp", "B", "d", "pallas",
+                                               "pad_nodes"))
 def _page_delta(bins, pos, ptab, leaf_value, *, Kp, B, d, pallas, pad_nodes):
     pos = partition_apply_xla(bins, pos, ptab, Kp=Kp, B=B, d=d)
     return leaf_delta(pos, leaf_value, pad_nodes, pallas=pallas)

@@ -33,11 +33,14 @@ non-TPU backends (CPU tests, virtual-device dryruns) via segment_sum.
 from __future__ import annotations
 
 import functools
+import threading
 from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from ..analysis.retrace import guard_jit
 
 __all__ = [
     "fused_level", "fused_level_xla", "partition_apply_xla", "leaf_delta",
@@ -120,9 +123,13 @@ def hoist_plan_synced(n_pad: int, F: int, B: int, max_depth: int = 6) -> int:
 
 
 # one-shot allocation-probe result: None until probed (or probe failed);
-# module-level so every hoist_plan of the session reuses the measurement
+# module-level so every hoist_plan of the session reuses the measurement.
+# Lock-guarded (lint CC402): two threads racing the unguarded check-then-
+# set would BOTH run the multi-second bisection, concurrently allocating
+# multi-GB device buffers — exactly the OOM the probe exists to avoid.
 _probed_free_bytes: Optional[int] = None
 _probe_done = False
+_probe_lock = threading.Lock()
 
 _PROBE_HI = 16 * 1024 * 1024 * 1024  # the AOT compiler's enforced ceiling
 _PROBE_STEP = 256 * 1024 * 1024  # resolution: 6 bisection steps from 16 GiB
@@ -135,42 +142,46 @@ def probe_free_bytes() -> Optional[int]:
     step allocates on-device zeros (no host transfer), syncs, and deletes —
     seconds total, vs the OOM-driven retry ladder that burned measurement
     windows. TPU-only: a CPU 'probe' would just thrash host RAM. The result
-    is cached for the process (None when probing is unavailable/failed)."""
+    is cached for the process (None when probing is unavailable/failed).
+    The lock makes the one-shot real: a second thread arriving mid-probe
+    waits for the measurement instead of launching a concurrent multi-GB
+    bisection of its own."""
     global _probed_free_bytes, _probe_done
-    if _probe_done:
-        return _probed_free_bytes
-    _probe_done = True
-    if jax.default_backend() != "tpu":
-        return None
+    with _probe_lock:
+        if _probe_done:
+            return _probed_free_bytes
+        _probe_done = True
+        if jax.default_backend() != "tpu":
+            return None
 
-    def fits(nbytes: int) -> bool:
+        def fits(nbytes: int) -> bool:
+            try:
+                a = jnp.zeros((nbytes,), jnp.uint8)
+                a.block_until_ready()
+                a.delete()
+                return True
+            except Exception:
+                return False
+
+        lo, hi = 0, _PROBE_HI  # invariant: lo fits (0 trivially), hi may not
         try:
-            a = jnp.zeros((nbytes,), jnp.uint8)
-            a.block_until_ready()
-            a.delete()
-            return True
+            while hi - lo > _PROBE_STEP:
+                mid = (lo + hi) // 2
+                if fits(mid):
+                    lo = mid
+                else:
+                    hi = mid
         except Exception:
-            return False
+            return None
+        if lo <= 0:
+            return None
+        _probed_free_bytes = lo
+        from ..utils import console_logger
 
-    lo, hi = 0, _PROBE_HI  # invariant: lo fits (0 trivially), hi may not
-    try:
-        while hi - lo > _PROBE_STEP:
-            mid = (lo + hi) // 2
-            if fits(mid):
-                lo = mid
-            else:
-                hi = mid
-    except Exception:
-        return None
-    if lo <= 0:
-        return None
-    _probed_free_bytes = lo
-    from ..utils import console_logger
-
-    console_logger.info(
-        f"device memory probe: largest releasable allocation "
-        f"{lo // (1024 * 1024)} MB (memory_stats unavailable)")
-    return _probed_free_bytes
+        console_logger.info(
+            f"device memory probe: largest releasable allocation "
+            f"{lo // (1024 * 1024)} MB (memory_stats unavailable)")
+        return _probed_free_bytes
 
 
 def hoist_budget_bytes() -> int:
@@ -245,7 +256,7 @@ def _build_onehot_body(bins_ref, out_ref, *, F: int, B: int):
         out_ref[:, f * B:(f + 1) * B] = (col == iota_b).astype(jnp.int8)
 
 
-@functools.partial(jax.jit, static_argnames=("B", "tr", "vma"))
+@guard_jit(name="onehot_build_pallas", static_argnames=("B", "tr", "vma"))
 def _build_onehot_pallas(bins: jax.Array, *, B: int, tr: int,
                          vma=()) -> jax.Array:
     """Tile-local build: each row-tile grid step compares its i32 bins
@@ -272,7 +283,7 @@ def _build_onehot_pallas(bins: jax.Array, *, B: int, tr: int,
     )(bins.astype(jnp.int32))
 
 
-@functools.partial(jax.jit, static_argnames=("B",))
+@guard_jit(name="onehot_build_xla", static_argnames=("B",))
 def _build_onehot_xla(bins: jax.Array, *, B: int) -> jax.Array:
     n, F = bins.shape
     iota = jnp.arange(B, dtype=jnp.int32)
@@ -420,8 +431,8 @@ def _vma_struct(shape, dtype, axes):
     return jax.ShapeDtypeStruct(shape, dtype)
 
 
-@functools.partial(jax.jit,
-                   static_argnames=("K", "Kp", "B", "d", "tr", "vma"))
+@guard_jit(name="fused_level_pallas",
+           static_argnames=("K", "Kp", "B", "d", "tr", "vma"))
 def _fused_level_pallas(bins, pos, gh, ptab, *, K, Kp, B, d, tr=TR,
                         vma=()):
     from jax.experimental import pallas as pl
@@ -500,8 +511,8 @@ def _hoisted_kernel(bins_ref, oh_ref, pos_ref, gh_ref, ptab_ref, pos_out,
         hist_ref[:, f * B:(f + 1) * B] += outf[: 2 * K] + outf[2 * K:]
 
 
-@functools.partial(jax.jit,
-                   static_argnames=("K", "Kp", "B", "d", "tr", "vma"))
+@guard_jit(name="hoisted_level_pallas",
+           static_argnames=("K", "Kp", "B", "d", "tr", "vma"))
 def _hoisted_level_pallas(bins, onehot, pos, gh, ptab, *, K, Kp, B, d,
                           tr=TR_HOIST, vma=()):
     from jax.experimental import pallas as pl
@@ -582,7 +593,7 @@ def partition_apply_xla(bins, pos, ptab, *, Kp: int, B: int, d: int):
     return p[:, None]
 
 
-@functools.partial(jax.jit, static_argnames=("K", "Kp", "B", "d"))
+@guard_jit(name="fused_level_xla", static_argnames=("K", "Kp", "B", "d"))
 def fused_level_xla(bins, pos, gh, ptab, *, K, Kp, B, d):
     """Same contract as the pallas kernel, for non-TPU backends: partition
     via (cheap on CPU) gathers, histogram via segment_sum scatter-add."""
