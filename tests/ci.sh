@@ -1,6 +1,7 @@
 #!/bin/bash
 # CI entry point (reference analog: Jenkinsfile / .github workflows +
-# sanitizer builds, CMakeLists.txt:61-64). Five tiers:
+# sanitizer builds, CMakeLists.txt:61-64). Tiers (0-4 plus the chaos
+# lane between 1 and 2):
 #   0. static-analysis gate: `python -m xgboost_tpu lint` must exit 0 —
 #      any unsuppressed trace-safety / retrace / dtype / concurrency
 #      finding (docs/static_analysis.md) fails CI before a single test
@@ -73,6 +74,50 @@ run_half() {
 run_half "tier-1 [a-e]" tests/test_[a-e]*.py
 run_half "tier-1 [f-z]" tests/test_[f-z]*.py
 unset XGBTPU_TRACE
+
+echo "=== tier 1.5: chaos-enabled smoke lane (seeded injection) ==="
+# Seeded deterministic faults at three resilience sites while a real
+# (tiny) training with per-round checkpointing runs end to end: the
+# chaos layer must inject, the retry policy must absorb the transients,
+# and the fault history must be visible in the metrics exposition
+# (docs/resilience.md). This exercises the degradation/retry machinery
+# on every CI run without hardware — the rabit-mock recovery test's role.
+XGBTPU_CHAOS="checkpoint_write:transient:1,3;pager_io:transient:2;native_load:transient:1" \
+XGBTPU_RETRY="*=3" python - <<'EOF'
+import tempfile
+
+import numpy as np
+
+import xgboost_tpu as xgb
+from xgboost_tpu.observability import REGISTRY
+from xgboost_tpu.resilience import chaos
+
+plan = chaos.active_plan()
+assert plan is not None and len(plan.specs) == 3, "chaos env not armed"
+
+rng = np.random.RandomState(0)
+X = rng.randn(2000, 6).astype(np.float32)
+y = (X[:, 0] > 0).astype(np.float32)
+ck = tempfile.mkdtemp()
+bst = xgb.train({"objective": "binary:logistic", "max_depth": 3,
+                 "max_bin": 16, "verbosity": 0},
+                xgb.DMatrix(X, label=y), 4, verbose_eval=False,
+                resume_from=ck)
+assert bst.num_boosted_rounds() == 4, "chaos lane lost rounds"
+pred = bst.predict(xgb.DMatrix(X))
+assert np.isfinite(pred).all()
+
+fired = [f for f in plan.fired if f[0] == "checkpoint_write"]
+assert len(fired) >= 2, f"checkpoint_write chaos never fired: {plan.fired}"
+exp = REGISTRY.exposition()
+assert 'faults_total{kind="transient",site="checkpoint_write"}' in exp, exp
+assert 'retries_total{site="checkpoint_write"}' in exp
+assert "chaos_injections_total" in exp
+assert 'degrade_state{capability="pallas_predict"}' in exp
+assert 'degrade_state{capability="onehot_build"}' in exp
+print(f"chaos smoke OK: {len(plan.fired)} injected faults absorbed, "
+      "fault history in exposition")
+EOF
 
 echo "=== tier 2: trace parses as Chrome trace JSON ==="
 # load_trace raises on malformed output; trace-report exits nonzero

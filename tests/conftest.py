@@ -35,3 +35,17 @@ except Exception:  # backends already initialized; tests will use what exists
 # flake under the suite's compile volume; the crashing test varies, every
 # file passes in isolation, and ~half of single-process full runs are
 # clean). tests/ci.sh splits the suite into two processes to sidestep it.
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_resilience_state():
+    """Capability health and chaos plans are PROCESS-wide by design (the
+    resilience layer replaced per-object latches); tests that degrade a
+    capability or arm a chaos plan must not poison later tests."""
+    yield
+    from xgboost_tpu.resilience import chaos, degrade
+
+    chaos.reset()
+    degrade.reset()

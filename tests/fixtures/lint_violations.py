@@ -12,7 +12,7 @@ import jax.numpy as jnp
 import numpy as np
 
 _CACHE = {}  # module-level mutable state (for RH202 / CC401)
-_latch = False  # module-level latch (for CC402)
+_latch = False  # module-level latch (for CC402 mutation + CC403 declaration)
 _lock = threading.Lock()  # present but unused at the violation sites
 
 

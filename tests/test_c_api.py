@@ -173,6 +173,7 @@ def test_c_api_custom_objective_boost(lib):
 C_HOST = r"""
 #include <stdio.h>
 #include <stdlib.h>
+#include <string.h>
 #include <math.h>
 
 typedef unsigned long long bst_ulong;
@@ -188,6 +189,9 @@ extern int XGBoosterUpdateOneIter(void*, int, void*);
 extern int XGBoosterPredict(void*, void*, int, unsigned, int,
                             bst_ulong*, const float**);
 extern int XGBoosterFree(void*);
+extern int XGBoosterSaveJsonConfig(void*, bst_ulong*, const char**);
+extern int XGBoosterSerializeToBuffer(void*, bst_ulong*, const char**);
+extern int XGBoosterUnserializeFromBuffer(void*, const void*, bst_ulong);
 
 #define CK(x) if ((x) != 0) { \
   fprintf(stderr, "FAIL: %s\n", XGBGetLastError()); return 1; }
@@ -223,6 +227,34 @@ int main(void) {
   for (int i = 0; i < N; ++i)
     correct += (out[i] > 0.5f) == (label[i] > 0.5f);
   printf("C_HOST_ACC=%.3f\n", (double)correct / N);
+
+  /* robustness surface (ISSUE 5 satellite): config JSON + full-state
+     serialize/unserialize round-trip through a FRESH booster must
+     reproduce predictions bit-for-bit */
+  bst_ulong cfg_len = 0;
+  const char *cfg = NULL;
+  CK(XGBoosterSaveJsonConfig(bst, &cfg_len, &cfg));
+  if (cfg_len == 0 || strstr(cfg, "learner") == NULL) {
+    fprintf(stderr, "bad config json\n"); return 1;
+  }
+  bst_ulong ser_len = 0;
+  const char *ser = NULL;
+  CK(XGBoosterSerializeToBuffer(bst, &ser_len, &ser));
+  void *bst2 = NULL;
+  CK(XGBoosterCreate(NULL, 0, &bst2));
+  CK(XGBoosterUnserializeFromBuffer(bst2, ser, ser_len));
+  bst_ulong len2 = 0;
+  const float *out2 = NULL;
+  CK(XGBoosterPredict(bst2, dmat, 0, 0, 0, &len2, &out2));
+  if (len2 != len) { fprintf(stderr, "bad unserialized len\n"); return 1; }
+  for (bst_ulong i = 0; i < len; ++i) {
+    if (out2[i] != out[i]) {
+      fprintf(stderr, "unserialized predict mismatch at %llu\n", i);
+      return 1;
+    }
+  }
+  printf("C_HOST_SERIALIZE=OK\n");
+  CK(XGBoosterFree(bst2));
   CK(XGBoosterFree(bst));
   CK(XGDMatrixFree(dmat));
   return 0;
@@ -252,6 +284,8 @@ def test_c_api_from_real_c_host(lib, tmp_path):
     assert out.returncode == 0, (out.stdout, out.stderr[-2000:])
     acc = float(out.stdout.split("C_HOST_ACC=")[1].split()[0])
     assert acc > 0.9, out.stdout
+    # the serialize/config surface ran and round-tripped bit-for-bit
+    assert "C_HOST_SERIALIZE=OK" in out.stdout, out.stdout
 
 
 def test_c_api_csr_dump_and_buffer_roundtrip(lib, tmp_path):
@@ -416,6 +450,91 @@ def test_c_api_set_uint_info_exact_above_2_24(lib):
     gp = np.ctypeslib.as_array(out_ptr, shape=(out_len.value,)).copy()
     # 2 groups of 2 rows each; the float detour collapsed them into one
     np.testing.assert_array_equal(gp, [0, 2, 4])
+    _check(lib, lib.XGDMatrixFree(h))
+
+
+def test_c_api_serialize_and_json_config(lib):
+    """XGBoosterSerializeToBuffer/UnserializeFromBuffer and
+    XGBoosterSaveJsonConfig/LoadJsonConfig (ISSUE 5 satellite; reference
+    c_api.h:990-1040): full-state round-trip preserves BOTH the model and
+    the learner configuration — the part Save/LoadModel drops."""
+    import json
+
+    X, y = _data(300, 4, seed=13)
+    n, F = X.shape
+    h = ctypes.c_void_p()
+    Xf = np.ascontiguousarray(X)
+    _check(lib, lib.XGDMatrixCreateFromMat(
+        Xf.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), n, F,
+        ctypes.c_float(float("nan")), ctypes.byref(h)))
+    yl = np.ascontiguousarray(y)
+    _check(lib, lib.XGDMatrixSetFloatInfo(
+        h, b"label", yl.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), n))
+    bh = ctypes.c_void_p()
+    mats = (ctypes.c_void_p * 1)(h)
+    _check(lib, lib.XGBoosterCreate(mats, 1, ctypes.byref(bh)))
+    for k, v in [(b"objective", b"binary:logistic"), (b"max_depth", b"4"),
+                 (b"eta", b"0.3"), (b"max_bin", b"16"), (b"seed", b"9"),
+                 (b"verbosity", b"0")]:
+        _check(lib, lib.XGBoosterSetParam(bh, k, v))
+    for it in range(3):
+        _check(lib, lib.XGBoosterUpdateOneIter(bh, it, h))
+
+    # --- SaveJsonConfig: parses, carries the configured params ---
+    lib.XGBoosterSaveJsonConfig.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_char_p)]
+    clen = ctypes.c_uint64()
+    cptr = ctypes.c_char_p()
+    _check(lib, lib.XGBoosterSaveJsonConfig(bh, ctypes.byref(clen),
+                                            ctypes.byref(cptr)))
+    cfg = json.loads(ctypes.string_at(cptr, clen.value))
+    assert cfg["learner"]["objective"]["name"] == "binary:logistic"
+    assert cfg["learner"]["gradient_booster"]["params"]["max_depth"] == "4"
+
+    # --- SerializeToBuffer -> fresh handle -> Unserialize: predictions
+    # AND config survive (LoadModelFromBuffer drops the config) ---
+    slen = ctypes.c_uint64()
+    sptr = ctypes.c_char_p()
+    _check(lib, lib.XGBoosterSerializeToBuffer(bh, ctypes.byref(slen),
+                                               ctypes.byref(sptr)))
+    blob = ctypes.string_at(sptr, slen.value)
+    assert slen.value > 0
+    bh2 = ctypes.c_void_p()
+    _check(lib, lib.XGBoosterCreate(None, 0, ctypes.byref(bh2)))
+    _check(lib, lib.XGBoosterUnserializeFromBuffer(bh2, blob, len(blob)))
+    plen = ctypes.c_uint64()
+    pptr = ctypes.POINTER(ctypes.c_float)()
+    _check(lib, lib.XGBoosterPredict(bh, h, 0, 0, 0, ctypes.byref(plen),
+                                     ctypes.byref(pptr)))
+    p1 = np.ctypeslib.as_array(pptr, shape=(plen.value,)).copy()
+    _check(lib, lib.XGBoosterPredict(bh2, h, 0, 0, 0, ctypes.byref(plen),
+                                     ctypes.byref(pptr)))
+    p2 = np.ctypeslib.as_array(pptr, shape=(plen.value,)).copy()
+    np.testing.assert_array_equal(p1, p2)
+    _check(lib, lib.XGBoosterSaveJsonConfig(bh2, ctypes.byref(clen),
+                                            ctypes.byref(cptr)))
+    cfg2 = json.loads(ctypes.string_at(cptr, clen.value))
+    assert cfg2["learner"]["gradient_booster"]["params"]["max_depth"] == "4"
+    assert cfg2["learner"]["objective"]["name"] == "binary:logistic"
+
+    # --- LoadJsonConfig configures a fresh booster equivalently ---
+    bh3 = ctypes.c_void_p()
+    _check(lib, lib.XGBoosterCreate(mats, 1, ctypes.byref(bh3)))
+    _check(lib, lib.XGBoosterLoadJsonConfig(
+        bh3, ctypes.string_at(cptr, clen.value)))
+    for it in range(3):
+        _check(lib, lib.XGBoosterUpdateOneIter(bh3, it, h))
+    _check(lib, lib.XGBoosterPredict(bh3, h, 0, 0, 0, ctypes.byref(plen),
+                                     ctypes.byref(pptr)))
+    p3 = np.ctypeslib.as_array(pptr, shape=(plen.value,)).copy()
+    np.testing.assert_array_equal(p3, p1)
+    # malformed buffer fails loudly with a retrievable message
+    rc = lib.XGBoosterUnserializeFromBuffer(bh2, b"not json", 8)
+    assert rc == -1 and lib.XGBGetLastError()
+    _check(lib, lib.XGBoosterFree(bh))
+    _check(lib, lib.XGBoosterFree(bh2))
+    _check(lib, lib.XGBoosterFree(bh3))
     _check(lib, lib.XGDMatrixFree(h))
 
 

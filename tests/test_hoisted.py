@@ -379,6 +379,9 @@ def test_hoist_build_failure_degrades(monkeypatch):
     monkeypatch.setattr(hk, "use_pallas", lambda: True)  # plan != 0 on CPU
     monkeypatch.setattr(hk, "build_onehot", boom)
     assert binned.fused_onehot(3) is None
-    assert binned._onehot_failed
-    assert binned.fused_onehot(3) is None  # latched: no per-call retry
+    from xgboost_tpu.data.quantile import _onehot_health
+    from xgboost_tpu.resilience import DISABLED
+
+    assert _onehot_health.state() == DISABLED
+    assert binned.fused_onehot(3) is None  # disabled: no per-call retry
     assert calls["n"] == 1

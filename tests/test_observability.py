@@ -290,15 +290,20 @@ def test_rounds_total_counts_update_paths():
 # ------------------------------------------------- satellite regressions
 
 def test_hoist_plan_mesh_zero_after_onehot_failure():
-    """data/quantile.py:401 — a latched one-hot build failure must zero the
-    mesh hoist plan, or chunked scans retry the failed build in-jit."""
+    """data/quantile.py — a DISABLED one-hot build capability must zero
+    the mesh hoist plan, or chunked scans retry the failed build in-jit.
+    (The per-object build-failure latch became the process-wide
+    ``onehot_build`` capability — ISSUE 5 tentpole.)"""
+    from xgboost_tpu.data.quantile import _onehot_health
     from xgboost_tpu.parallel.mesh import make_mesh
+    from xgboost_tpu.resilience import DISABLED
 
     X, _ = _data(n=64, F=3)
     d = xgb.DMatrix(X, label=np.zeros(64, np.float32))
     bm = d.get_binned(16)
     mesh = make_mesh()
-    bm._onehot_failed = True
+    _onehot_health.failure(RuntimeError("synthetic mosaic reject"))
+    assert _onehot_health.state() == DISABLED
     assert bm.hoist_plan_mesh(mesh) == 0
     assert bm.fused_onehot_mesh(mesh) is None
 

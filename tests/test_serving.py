@@ -237,19 +237,29 @@ def test_sklearn_predict_uses_inplace_path():
 
 
 def test_pallas_blacklist_retry_escape():
-    """ISSUE 2 satellite (VERDICT weak #7): a blacklisted forest shape is
-    skipped for N predicts, then retried instead of being poisoned for the
-    life of the process."""
-    from xgboost_tpu import predictor
+    """ISSUE 2 satellite (VERDICT weak #7), now on the resilience layer:
+    a degraded forest shape is skipped for N predicts, then retried
+    instead of being poisoned for the life of the process — and the state
+    is visible in the metrics exposition (ISSUE 5 tentpole)."""
+    from xgboost_tpu.observability import REGISTRY
+    from xgboost_tpu.predictor import _pallas_health
+    from xgboost_tpu.resilience import DEGRADED, HEALTHY
 
     key = ("test", "shape", 1, 2, 3)
-    assert not predictor._pallas_shape_blocked(key)  # unknown: not blocked
-    predictor._pallas_pred_broken[key] = 3
-    assert predictor._pallas_shape_blocked(key)  # skip 1
-    assert predictor._pallas_shape_blocked(key)  # skip 2
-    assert predictor._pallas_shape_blocked(key)  # skip 3, countdown done
-    assert key not in predictor._pallas_pred_broken
-    assert not predictor._pallas_shape_blocked(key)  # retry allowed
+    assert _pallas_health.allowed(key)  # unknown: not blocked
+    kind = _pallas_health.failure(
+        RuntimeError("synthetic vmem overflow"), key=key, retry_after=3)
+    assert kind == "permanent"
+    assert _pallas_health.state(key) == DEGRADED
+    assert 'degrade_state{capability="pallas_predict"} 1' in \
+        REGISTRY.exposition()
+    assert not _pallas_health.allowed(key)  # skip 1
+    assert not _pallas_health.allowed(key)  # skip 2
+    assert not _pallas_health.allowed(key)  # skip 3, countdown done
+    assert _pallas_health.state(key) == HEALTHY
+    assert _pallas_health.allowed(key)  # retry allowed
+    _pallas_health.success(key)  # recovery clears the failure history
+    assert _pallas_health.snapshot()["entries"] == {}
 
 
 def test_hoist_budget_uses_probe_when_stats_missing(monkeypatch):
@@ -267,4 +277,4 @@ def test_hoist_budget_uses_probe_when_stats_missing(monkeypatch):
     monkeypatch.setattr(hk, "probe_free_bytes", lambda: None)
     assert hk.hoist_budget_bytes() == 8192 * 1024 * 1024
     # on this CPU test runner the real probe must refuse to run
-    assert hk.probe_free_bytes() is None or hk._probe_done
+    assert hk.probe_free_bytes() is None or hk._probe.done

@@ -47,6 +47,7 @@ from . import callback  # noqa: F401
 from . import collective  # noqa: F401
 from . import collective as rabit  # noqa: F401  (legacy alias)
 from . import observability  # noqa: F401  (span tracing + metrics registry)
+from . import resilience  # noqa: F401  (failure policy / degrade / chaos)
 from . import objective  # noqa: F401  (registers objectives)
 from . import metric  # noqa: F401  (registers metrics)
 from .gbm import GBTree, Dart, GBLinear  # noqa: F401
@@ -64,6 +65,7 @@ __all__ = [
     "cv",
     "callback",
     "observability",
+    "resilience",
     "config_context",
     "set_config",
     "get_config",

@@ -71,7 +71,18 @@ ALL_RULES = {
     "DT302": "np.float64 literal in device-adjacent code",
     "CC401": "module-level mutable state mutated outside a lock",
     "CC402": "global rebound outside a lock",
+    "CC403": "module-level fallback latch outside resilience/degrade.py",
 }
+
+# CC403: module-level names that read as fallback latches (broken/failed/
+# blocked/... flags and blacklist dicts). Capability state belongs in the
+# resilience layer (keyed, lock-guarded, metric-exported, retryable) —
+# a fresh ad-hoc latch is exactly the unobservable one-off state ISSUE 5
+# deleted. Only ``resilience/degrade.py`` (the state machine itself) may
+# declare such names.
+_CC403_WORDS = ("broken", "failed", "blocked", "latch", "disabled",
+                     "blacklist", "poisoned")
+_CC403_EXEMPT = "resilience/degrade.py"
 
 # attribute (or bare imported) names that stage/trace their function args
 _TRACE_ENTRIES = {
@@ -925,6 +936,29 @@ def _pass_concurrency(project: _Project) -> List[Finding]:
                         "CC401", mod.relpath, hit.lineno, fn.qualname,
                         "module-level mutable state mutated outside a "
                         "lock: concurrent callers corrupt it"))
+
+    # CC403: latch-shaped module-level declarations outside the resilience
+    # state machine (name-based — the point is to force new fallback state
+    # through degrade.CapabilityHealth / OneShot, not to prove raciness)
+    for mod in project.modules:
+        if mod.relpath.endswith(_CC403_EXEMPT):
+            continue
+        for node in mod.tree.body:
+            targets: List[ast.expr] = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, ast.AnnAssign):
+                targets = [node.target]
+            for t in targets:
+                if not isinstance(t, ast.Name):
+                    continue
+                name = t.id.lower()
+                if any(w in name for w in _CC403_WORDS):
+                    out.append(Finding(
+                        "CC403", mod.relpath, node.lineno, t.id,
+                        f"module-level fallback latch {t.id!r}: use a "
+                        "resilience/degrade.py capability (keyed, "
+                        "lock-guarded, metric-exported) instead"))
     return out
 
 

@@ -76,7 +76,12 @@ def retrace_budget(name: str) -> Optional[int]:
 def note_retrace(name: str) -> None:
     """Record one (re)trace of ``name``: bump the counter and enforce the
     budget. Called from inside tracing, so a raise aborts the compile and
-    surfaces at the jit call site."""
+    surfaces at the jit call site — which also makes it the ``compile``
+    chaos-injection site: ``XGBTPU_CHAOS="compile:..."`` scripts a failing
+    guarded compile (resilience tentpole)."""
+    from ..resilience import chaos
+
+    chaos.hit("compile")
     with _lock:
         count = _counts.get(name, 0) + 1
         _counts[name] = count

@@ -160,7 +160,19 @@ class PagedBins:
     def read_page(self, k: int) -> np.ndarray:
         """[rows_of(k), F] narrow-int bins; prefetch of k+1 starts in the
         native worker before this call returns. Pages are stored
-        bit-packed (``self.bits`` per entry) and unpacked here."""
+        bit-packed (``self.bits`` per entry) and unpacked here. Page IO is
+        the ``pager_io`` resilience site: transient read failures (a
+        flaky disk, injected chaos) are retried under ``XGBTPU_RETRY``
+        before surfacing."""
+        from ..resilience import policy
+
+        return policy.RetryPolicy("pager_io", retries=2).run(
+            self._read_page_once, k)
+
+    def _read_page_once(self, k: int) -> np.ndarray:
+        from ..resilience import chaos
+
+        chaos.hit("pager_io")
         rows = self.rows_of(k)
         raw = np.empty((self.page_bytes(k),), np.uint8)
         self._open()
@@ -260,10 +272,10 @@ class ExternalMemoryQuantileDMatrix(DMatrix):
         dtype = np.dtype(storage_dtype(max_bin))
         paged = PagedBins(cache_prefix, cuts, n_rows, F, page_rows, dtype)
 
-        def write_page(k: int, arr: np.ndarray) -> None:
-            arr = np.ascontiguousarray(arr)
-            if paged.packed:  # ELLPACK symbol compression on disk
-                arr = pack_symbols(arr, paged.bits)
+        def write_page_once(k: int, arr: np.ndarray) -> None:
+            from ..resilience import chaos
+
+            chaos.hit("pager_io")
             if lib is not None:
                 import ctypes
 
@@ -274,6 +286,17 @@ class ExternalMemoryQuantileDMatrix(DMatrix):
                 if rc == 0:
                     return
             arr.tofile(paged.page_path(k))
+
+        def write_page(k: int, arr: np.ndarray) -> None:
+            from ..resilience import policy
+
+            arr = np.ascontiguousarray(arr)
+            if paged.packed:  # ELLPACK symbol compression on disk
+                arr = pack_symbols(arr, paged.bits)
+            # pager_io resilience site (shared with read_page): transient
+            # spill failures retry under XGBTPU_RETRY before failing ingest
+            policy.RetryPolicy("pager_io", retries=2).run(
+                write_page_once, k, arr)
 
         it.reset()
         carry = np.zeros((0, F), dtype)

@@ -31,10 +31,27 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..resilience import chaos as _chaos, degrade as _degrade, policy as _policy
+
 __all__ = [
     "HistogramCuts", "compute_cuts", "compute_exact_cuts", "bin_matrix",
     "BinnedMatrix", "apply_categorical_identity",
 ]
+
+# Health of the hoisted one-hot build (the on-device Pallas tile build,
+# tree/hist_kernel.py:build_onehot). A PERMANENT failure — a Mosaic
+# reject of the int8 tile store on this runtime — DISABLES the capability
+# for the process (disable_after=1: a compiler reject is deterministic
+# per runtime, so re-trying it per fit would just re-pay the failed
+# compile). A RESOURCE failure (temporary HBM pressure) only DEGRADES —
+# the next fit after the 1-call retry window probes the build again, so a
+# long-lived process recovers the fast path when memory frees. Training
+# proceeds on the in-kernel construct path either way. Replaces the
+# per-object boolean latch of earlier rounds (resilience tentpole): state
+# is process-visible as ``degrade_state{capability="onehot_build"}``.
+_onehot_health = _degrade.capability(
+    "onehot_build", retry_after=1, disable_after=1,
+    disable_kinds=(_policy.PERMANENT,))
 
 
 def apply_categorical_identity(values: np.ndarray, min_vals: np.ndarray,
@@ -271,12 +288,10 @@ class BinnedMatrix:
     # kernel (training-invariant; built once per fit — tree/hist_kernel.py)
     _onehot: Optional[jax.Array] = None
     # mesh twin: row-sharded one-hot, keyed by mesh id — built once per
-    # (fit, mesh), NOT once per tree (VERDICT r4 weak #5)
+    # (fit, mesh), NOT once per tree (VERDICT r4 weak #5). Build failures
+    # degrade the process-wide ``onehot_build`` capability (module above)
+    # instead of latching on this object.
     _onehot_mesh: Optional[Tuple[int, Optional[jax.Array]]] = None
-    # latched when the hoist build itself fails on-device (e.g. a Mosaic
-    # reject of the int8 tile store): training proceeds on the construct
-    # kernel instead of crashing, and the build is not retried per call
-    _onehot_failed: bool = False
     # frozen process-synced hoist plan, keyed by mesh id: ONE allgather
     # per (fit, mesh), never per chunk — and immune to free-HBM drift
     # flipping a jit static arg mid-fit
@@ -320,7 +335,7 @@ class BinnedMatrix:
         # the plan, and rebuild every round (thrash + transient 2x HBM).
         if self._onehot is not None:
             return self._onehot
-        if self._onehot_failed:
+        if not _onehot_health.allowed():
             return None
         fh = hoist_plan(n_pad, self.n_features, B, max_depth)
         if fh == 0:
@@ -336,17 +351,21 @@ class BinnedMatrix:
             f"HBM-resident ({n_pad}x{fh}x{B} int8){part}; "
             "levels stream it through the MXU")
         try:
+            _chaos.hit("pallas")
             self._onehot = build_onehot(bins[:, :fh], B=B)
         except Exception as e:
             # e.g. a Mosaic compile reject of the tile build on this
             # runtime: degrade to the in-kernel construct path rather
-            # than failing the fit, and don't retry per call
-            self._onehot_failed = True
+            # than failing the fit. Non-transient kinds DISABLE the
+            # capability (never re-tried per call); transients fall back
+            # for this fit only.
+            kind = _onehot_health.failure(e)
             console_logger.warning(
-                f"tpu_hist: hoisted one-hot build failed "
-                f"({type(e).__name__}: {e}); training on the in-kernel "
+                f"tpu_hist: hoisted one-hot build failed ({kind}; "
+                f"{type(e).__name__}: {e}); training on the in-kernel "
                 "construction path instead")
             return None
+        _onehot_health.success()
         return self._onehot
 
     def fused_onehot_mesh(self, mesh, max_depth: int = 6
@@ -366,26 +385,28 @@ class BinnedMatrix:
 
         if self._onehot_mesh is not None and self._onehot_mesh[0] == id(mesh):
             return self._onehot_mesh[1]
-        if self._onehot_failed:
+        if not _onehot_health.allowed():
             return None
         binsf, n_pad = self.fused_bins_mesh(mesh)
         B = self.cuts.max_bin
         fh = self.hoist_plan_mesh(mesh, max_depth)
         if fh:
             try:
+                _chaos.hit("pallas")
                 oh = jax.shard_map(
                     lambda b: build_onehot(b[:, :fh], B=B, vma=(ROW_AXIS,)),
                     mesh=mesh, in_specs=P(ROW_AXIS, None),
                     out_specs=P(ROW_AXIS, None))(binsf)
+                _onehot_health.success()
             except Exception as e:
                 # same degrade as fused_onehot: a build failure must not
                 # fail the fit
-                self._onehot_failed = True
+                kind = _onehot_health.failure(e)
                 from ..utils import console_logger
 
                 console_logger.warning(
                     f"tpu_hist: mesh hoisted one-hot build failed "
-                    f"({type(e).__name__}: {e}); training on the "
+                    f"({kind}; {type(e).__name__}: {e}); training on the "
                     "in-kernel construction path instead")
                 oh = None
             if jax.process_count() > 1:
@@ -399,7 +420,9 @@ class BinnedMatrix:
                 ok_all = _np.asarray(multihost_utils.process_allgather(
                     _np.asarray(0 if oh is None else 1, _np.int64)))
                 if int(ok_all.min()) == 0 and oh is not None:
-                    self._onehot_failed = True
+                    # a peer rank's asymmetric failure is a resource
+                    # problem for the whole SPMD program: disable here too
+                    _onehot_health.failure(kind=_policy.RESOURCE)
                     oh = None
         else:
             oh = None
@@ -415,8 +438,8 @@ class BinnedMatrix:
         recompile when free memory drifts across a feature boundary."""
         from ..tree.hist_kernel import hoist_plan_synced
 
-        if self._onehot_failed:
-            # the latch means the expansion cannot exist on this runtime:
+        if _onehot_health.state() == _degrade.DISABLED:
+            # disabled means the expansion cannot exist on this runtime:
             # a nonzero plan here would send the chunk scans back to the
             # failed hoisted build every round (ADVICE r5)
             return 0

@@ -82,6 +82,12 @@ def _compile(src: str, lib_path: str, extra: list, timeout: int = 120) -> bool:
         extra = list(extra) + _SAN_FLAGS
     cmd = ["g++", "-shared", "-fPIC", "-o", lib_path, src] + extra
     try:
+        # ``native_load`` chaos site: a scripted fault here exercises the
+        # graceful every-caller-falls-back-to-None contract of the
+        # on-demand native builds (resilience tentpole)
+        from ..resilience import chaos
+
+        chaos.hit("native_load")
         subprocess.run(cmd, check=True, capture_output=True, timeout=timeout)
         return True
     except Exception:

@@ -18,6 +18,9 @@
 //   XGBoosterPredict                 c_api.h:865 (option_mask 0/1)
 //   XGBoosterPredictFromDense/CSR    c_api.cc:833 (zero-copy inplace)
 //   XGBoosterSaveModel/LoadModel, XGBoosterGetNumFeature
+//   XGBoosterSerializeToBuffer/UnserializeFromBuffer  c_api.h:1030 (model
+//     + learner configuration — the full-state pair Save/LoadModel drops)
+//   XGBoosterSaveJsonConfig/LoadJsonConfig            c_api.h:990
 //   XGBoosterSetAttr/GetAttr, XGBVersion
 // Error contract matches the reference: every call returns 0 on success,
 // -1 on failure with the message retrievable via XGBGetLastError().
@@ -125,6 +128,8 @@ struct BoosterWrap {
   std::string eval_out;     // XGBoosterEvalOneIter out-string
   std::string attr_out;     // XGBoosterGetAttr out-string
   std::string raw_out;      // XGBoosterSaveModelToBuffer out-bytes
+  std::string serialize_out;  // XGBoosterSerializeToBuffer out-bytes
+  std::string config_out;     // XGBoosterSaveJsonConfig out-string
   std::vector<bst_ulong> pred_shape;  // PredictFromDMatrix out-shape
   std::vector<std::string> dump;      // XGBoosterDumpModel storage
   std::vector<const char *> dump_ptrs;
@@ -685,6 +690,103 @@ XGB_DLL int XGBoosterLoadModelFromBuffer(BoosterHandle handle,
   if (b == nullptr) return fail();
   PyObject *r = PyObject_CallMethod(w->obj, "load_model", "O", b);
   Py_DECREF(b);
+  if (r == nullptr) return fail();
+  Py_DECREF(r);
+  return 0;
+}
+
+XGB_DLL int XGBoosterSaveJsonConfig(BoosterHandle handle,
+                                    bst_ulong *out_len,
+                                    char const **out_str) {
+  // learner configuration as JSON (reference c_api.h:990 /
+  // learner.cc:SaveConfig) — params + booster + objective, enough for
+  // LoadJsonConfig to reconstruct an equivalently-configured Booster
+  Gil gil;
+  auto *w = static_cast<BoosterWrap *>(handle);
+  PyObject *r = PyObject_CallMethod(w->obj, "save_config", nullptr);
+  if (r == nullptr) return fail();
+  const char *s = PyUnicode_AsUTF8(r);
+  if (s == nullptr) {
+    Py_DECREF(r);
+    return fail();
+  }
+  w->config_out = s;
+  Py_DECREF(r);
+  *out_len = static_cast<bst_ulong>(w->config_out.size());
+  *out_str = w->config_out.c_str();
+  return 0;
+}
+
+XGB_DLL int XGBoosterLoadJsonConfig(BoosterHandle handle,
+                                    char const *config) {
+  Gil gil;
+  auto *w = static_cast<BoosterWrap *>(handle);
+  if (config == nullptr) return fail_msg("LoadJsonConfig: null config");
+  PyObject *r = PyObject_CallMethod(w->obj, "load_config", "s", config);
+  if (r == nullptr) return fail();
+  Py_DECREF(r);
+  return 0;
+}
+
+XGB_DLL int XGBoosterSerializeToBuffer(BoosterHandle handle,
+                                       bst_ulong *out_len,
+                                       char const **out_dptr) {
+  // FULL state — model AND learner configuration (reference c_api.h:1030;
+  // SaveModelToBuffer drops the config). Payload is the Booster's pickle
+  // state dict as JSON (json.dumps(booster.__getstate__(), default=float)
+  // — the exact round-trip Booster.__deepcopy__ relies on).
+  Gil gil;
+  auto *w = static_cast<BoosterWrap *>(handle);
+  PyObject *st = PyObject_CallMethod(w->obj, "__getstate__", nullptr);
+  if (st == nullptr) return fail();
+  PyObject *jmod = imp("json");
+  PyObject *builtins = imp("builtins");
+  PyObject *dumps = jmod ? PyObject_GetAttrString(jmod, "dumps") : nullptr;
+  PyObject *flt =
+      builtins ? PyObject_GetAttrString(builtins, "float") : nullptr;
+  PyObject *args = Py_BuildValue("(O)", st);
+  PyObject *kw = PyDict_New();
+  PyObject *r = nullptr;
+  if (dumps != nullptr && flt != nullptr && args != nullptr &&
+      kw != nullptr) {
+    PyDict_SetItemString(kw, "default", flt);
+    r = PyObject_Call(dumps, args, kw);
+  }
+  Py_XDECREF(kw);
+  Py_XDECREF(args);
+  Py_XDECREF(flt);
+  Py_XDECREF(dumps);
+  Py_DECREF(st);
+  if (r == nullptr) return fail();
+  const char *s = PyUnicode_AsUTF8(r);
+  if (s == nullptr) {
+    Py_DECREF(r);
+    return fail();
+  }
+  w->serialize_out = s;
+  Py_DECREF(r);
+  *out_len = static_cast<bst_ulong>(w->serialize_out.size());
+  *out_dptr = w->serialize_out.data();
+  return 0;
+}
+
+XGB_DLL int XGBoosterUnserializeFromBuffer(BoosterHandle handle,
+                                           const void *buf,
+                                           bst_ulong len) {
+  Gil gil;
+  auto *w = static_cast<BoosterWrap *>(handle);
+  if (buf == nullptr) return fail_msg("UnserializeFromBuffer: null buffer");
+  PyObject *jmod = imp("json");
+  if (jmod == nullptr) return fail();
+  PyObject *text = PyUnicode_DecodeUTF8(
+      static_cast<const char *>(buf), static_cast<Py_ssize_t>(len),
+      nullptr);
+  if (text == nullptr) return fail();
+  PyObject *state = PyObject_CallMethod(jmod, "loads", "O", text);
+  Py_DECREF(text);
+  if (state == nullptr) return fail();
+  PyObject *r = PyObject_CallMethod(w->obj, "__setstate__", "O", state);
+  Py_DECREF(state);
   if (r == nullptr) return fail();
   Py_DECREF(r);
   return 0;

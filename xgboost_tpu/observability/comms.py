@@ -39,7 +39,14 @@ _BYTES_HELP = "Payload bytes moved through collectives by kind"
 def record(op: str, nbytes: int, n_ops: int = 1) -> None:
     """Account ``n_ops`` collective operations moving ``nbytes`` total
     payload bytes under the kind ``op`` (e.g. ``allreduce``, ``broadcast``,
-    ``psum_hist``, ``all_gather_sketch``, ``process_allgather``)."""
+    ``psum_hist``, ``all_gather_sketch``, ``process_allgather``). Doubles
+    as the ``collective`` chaos-injection site: every accounted collective
+    passes this choke point, so ``XGBTPU_CHAOS="collective:..."`` scripts
+    a failing reduction without hardware (rabit-mock analog). Lazy import:
+    the resilience layer depends on this package, not vice versa."""
+    from ..resilience import chaos
+
+    chaos.hit("collective")
     REGISTRY.counter("collective_ops_total", _OPS_HELP).labels(
         op=op).inc(n_ops)
     REGISTRY.counter("collective_bytes_total", _BYTES_HELP).labels(

@@ -22,6 +22,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import _compat  # noqa: F401  (pre-0.5 jax shard_map/pcast shims)
+from ..resilience import watchdog as _wd
 
 ROW_AXIS = "data"  # the one parallel axis of GBDT training: rows
 
@@ -85,12 +86,35 @@ def init_distributed(
     auto-detects (TPU pods). Returns the global mesh.
     """
     if num_processes is not None and num_processes > 1:
-        jax.distributed.initialize(
-            coordinator_address=coordinator_address,
-            num_processes=num_processes,
-            process_id=process_id,
-            local_device_ids=local_device_ids,
-        )
+        # CPU backends need an explicit cross-process collectives
+        # implementation on this jax (0.4.37 defaults to "none", which
+        # makes EVERY multi-process computation fail with "Multiprocess
+        # computations aren't implemented on the CPU backend"): pick gloo
+        # when the option exists and is unset. TPU runtimes ignore it.
+        import os as _os
+
+        if ("cpu" in (_os.environ.get("JAX_PLATFORMS") or "")
+                and not _os.environ.get(
+                    "JAX_CPU_COLLECTIVES_IMPLEMENTATION")):
+            try:
+                jax.config.update(
+                    "jax_cpu_collectives_implementation", "gloo")
+            except Exception:
+                pass  # other jax versions: sensible default, no such knob
+        # Deadline around the rendezvous: a wedged coordinator/relay here
+        # is the mid-claim failure mode that burned bench round 5 —
+        # better a clean WatchdogTimeout than a 10-hour hang. Default
+        # 900s (a healthy claim takes seconds-to-minutes); tune/disable
+        # via XGBTPU_WATCHDOG="collective_init=...".
+        with _wd.watchdog("collective_init",
+                          seconds=_wd.deadline_for("collective_init",
+                                                   900.0)):
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+                local_device_ids=local_device_ids,
+            )
     return make_mesh(devices=jax.devices())
 
 

@@ -13,13 +13,14 @@ from __future__ import annotations
 
 import functools
 import os
-import threading
 from functools import partial
 from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from ..resilience import chaos as _chaos, degrade as _degrade, policy as _policy
 
 
 class StackedForest(NamedTuple):
@@ -203,40 +204,23 @@ _predict_margin_kernel = partial(
 
 _PRED_TAB_VMEM = 4 * 1024 * 1024  # byte budget for the [T, N, 8] table
 
-# forest shapes whose pallas walk failed to compile (scoped-vmem OOM):
-# those predict via the XLA gather walk instead of retry-compiling. Maps
-# shape-key -> remaining attempts to skip: a "permanent" classification is
-# really a heuristic (exception type + substring matching), so after N
-# skipped attempts the shape gets ONE retry — a transiently misclassified
-# failure (e.g. a relay error whose message happened to contain "vmem")
-# is no longer blacklisted for the life of the process (VERDICT weak #7).
-# Lock-guarded (lint CC401): concurrent predicts share the countdown, and
-# an unguarded read-decrement-write pair loses decrements — which silently
-# STRETCHES the blacklist window under serving concurrency.
-_pallas_pred_broken: dict = {}
-_pallas_pred_lock = threading.Lock()
-
-try:
-    _PALLAS_RETRY_AFTER = max(
-        1, int(os.environ.get("XGBTPU_PALLAS_RETRY_AFTER", "64")))
-except ValueError:  # malformed env must not break package import
-    _PALLAS_RETRY_AFTER = 64
+def _env_pallas_retry_after() -> int:
+    try:
+        return max(1, int(os.environ.get("XGBTPU_PALLAS_RETRY_AFTER", "64")))
+    except ValueError:  # malformed env must not break package import
+        return 64
 
 
-def _pallas_shape_blocked(key: tuple) -> bool:
-    """Whether the pallas walk should be skipped for this forest shape.
-    Each skipped attempt decrements the countdown; at zero the key is
-    dropped so the NEXT call retries the pallas compile (re-blacklisting on
-    a repeat failure)."""
-    with _pallas_pred_lock:
-        left = _pallas_pred_broken.get(key)
-        if left is None:
-            return False
-        if left <= 1:
-            _pallas_pred_broken.pop(key, None)
-            return True
-        _pallas_pred_broken[key] = left - 1
-        return True
+# Health of the pallas walk, keyed by forest shape: a shape whose compile
+# failed (scoped-vmem OOM, Mosaic reject) predicts via the XLA gather walk
+# while DEGRADED and is re-probed after N skipped attempts — a "permanent"
+# classification is really a heuristic, so nothing is blacklisted for the
+# life of the process (VERDICT weak #7). State, countdown, locking,
+# metrics (degrade_state{capability="pallas_predict"}) and transition
+# spans all live in the shared resilience layer, which replaced the
+# module-latch dict that used to sit here.
+_pallas_health = _degrade.capability(
+    "pallas_predict", retry_after=_env_pallas_retry_after())
 
 
 def _pred_kernel(x_ref, tab_ref, ohg_ref, out_ref, *, T, Np, F, G, steps):
@@ -381,15 +365,16 @@ def predict_margin(
     else:
         tw = jnp.ones((T,), jnp.float32)
     Np = forest.left.shape[1]
+    shape_key = (T, Np, forest.max_depth, X.shape[1], forest.n_groups)
     if (
         forest.heap_layout
         and not forest.has_cats
         and jax.default_backend() == "tpu"
         and T * Np * 8 * 2 <= _PRED_TAB_VMEM
-        and not _pallas_shape_blocked(
-            (T, Np, forest.max_depth, X.shape[1], forest.n_groups))
+        and _pallas_health.allowed(shape_key)
     ):
         try:
+            _chaos.hit("pallas")
             tab, ohg = _build_pred_tables(
                 forest.left, forest.feature, forest.cond, forest.default_left,
                 forest.tree_group, tw, forest.n_groups,
@@ -397,34 +382,27 @@ def predict_margin(
             margins = _predict_margin_pallas(
                 jnp.asarray(X, jnp.float32), tab, ohg, forest.max_depth
             )  # [n, G]
+            _pallas_health.success(shape_key)
             return base_margin + margins
         except Exception as e:
-            # compiler-layer failures (scoped-vmem OOM, Mosaic rejects) are
-            # permanent for this shape: recognized by exception TYPE, or by
-            # the two compiler-specific substrings for errors the runtime
-            # re-wraps. Anything else is treated as transient — it falls
-            # back this call but may retry later. Both outcomes are logged
-            # so the perf cliff is observable.
+            # policy.classify: compiler-layer failures (scoped-vmem OOM,
+            # Mosaic rejects) degrade this shape; anything else is
+            # transient — it falls back this call but may retry
+            # immediately (XlaRuntimeError also wraps device-busy / relay
+            # hiccups, so the type alone must not blacklist — ADVICE r4).
+            # Both outcomes are logged so the perf cliff is observable.
             from ..utils import console_logger
 
-            # XlaRuntimeError/JaxRuntimeError also wrap TRANSIENT runtime
-            # failures (device busy, relay hiccup — the documented failure
-            # mode here), so type alone must not blacklist; those types are
-            # permanent only with a compile-layer substring (ADVICE r4).
-            permanent = type(e).__name__ in (
-                "NotImplementedError", "MosaicError",
-            ) or any(t in str(e).lower() for t in ("vmem", "mosaic"))
-            if permanent:
-                key = (T, Np, forest.max_depth, X.shape[1], forest.n_groups)
-                with _pallas_pred_lock:
-                    _pallas_pred_broken[key] = _PALLAS_RETRY_AFTER
-                console_logger.warning(
-                    f"pallas predictor disabled for forest shape {key} "
-                    f"(retry after {_PALLAS_RETRY_AFTER} predicts): "
-                    f"{str(e)[:200]}")
-            else:
+            kind = _pallas_health.failure(
+                e, key=shape_key, retry_after=_env_pallas_retry_after())
+            if kind == _policy.TRANSIENT:
                 console_logger.warning(
                     f"pallas predictor fell back (transient): {str(e)[:200]}")
+            else:
+                console_logger.warning(
+                    f"pallas predictor degraded for forest shape "
+                    f"{shape_key} ({kind}; retry after "
+                    f"{_env_pallas_retry_after()} predicts): {str(e)[:200]}")
     return _predict_margin_kernel(
         jnp.asarray(X, jnp.float32),
         forest.left, forest.right, forest.feature, forest.cond,

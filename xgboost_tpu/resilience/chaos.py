@@ -1,0 +1,239 @@
+"""Named-site chaos injection with seeded deterministic schedules.
+
+Generalization of ``utils/fault.py``'s rabit-mock analog (reference:
+``rabit/src/allreduce_mock.h:20-50`` — scripted worker faults proving
+recovery from the last checkpoint): where the mock keys on
+(version, seqno) inside the training loop, chaos keys on NAMED SITES
+spread across every fallible layer, so each degradation edge and retry
+path is exercisable in tier-1 tests without hardware:
+
+==================  =====================================================
+site                injection point
+==================  =====================================================
+``compile``         every guarded jit (re)trace (``analysis/retrace.py``)
+``pallas``          pallas kernel build/dispatch attempts (predictor walk,
+                    hoisted one-hot build)
+``collective``      every accounted collective (``observability/comms``)
+``pager_io``        external-memory page read/write (``data/external.py``)
+``native_load``     on-demand g++ builds of native libs (``native/``)
+``checkpoint_write``  atomic checkpoint writes (``resilience/checkpoint``)
+``gradient``/``grow``/``eval``  the per-round host dispatch boundaries
+                    (``utils/fault.py`` sites, bridged here)
+==================  =====================================================
+
+Configuration — ``XGBTPU_CHAOS="site:kind:schedule[;site:kind:schedule]"``
+or programmatically via ``configure(...)``:
+
+- ``kind``: ``transient`` | ``resource`` | ``permanent`` — the fault's
+  classification under ``policy.classify`` (the raised ``ChaosError``
+  subclass carries it).
+- ``schedule``: comma-separated specs over the site's 1-based hit counter:
+  ``N`` (exactly the Nth hit), ``N-M`` (hits N..M), ``N+`` (every hit from
+  N on), ``%K`` (every Kth hit), ``pP@S`` (each hit fires with probability
+  P, decided by a deterministic hash of (site, hit, seed S) — the same
+  seed always fires the same hits, across processes and reruns).
+
+Example: ``XGBTPU_CHAOS="pallas:permanent:1;collective:transient:2,5"``.
+
+Injection sites call ``chaos.hit(name)`` — a single attribute check when
+nothing is armed, so production cost is nil.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import zlib
+from typing import Dict, Iterator, List, Optional
+
+from . import policy
+
+__all__ = [
+    "ChaosError", "ChaosTransient", "ChaosResource", "ChaosPermanent",
+    "SITES", "hit", "configure", "active_plan", "reset",
+]
+
+_ENV = "XGBTPU_CHAOS"
+
+#: the documented injection sites (informational — arbitrary names work,
+#: e.g. synthetic sites in tests)
+SITES = ("compile", "pallas", "collective", "pager_io", "native_load",
+         "checkpoint_write", "gradient", "grow", "eval")
+
+
+class ChaosError(RuntimeError):
+    """An injected fault. ``chaos_kind`` is read by ``policy.classify`` so
+    the fault degrades/retries exactly like the real failure it scripts."""
+
+    chaos_kind = policy.TRANSIENT
+
+    def __init__(self, site: str, hit_index: int):
+        super().__init__(
+            f"chaos: injected {self.chaos_kind} fault at site={site!r} "
+            f"(hit {hit_index})")
+        self.site = site
+        self.hit_index = hit_index
+
+
+class ChaosTransient(ChaosError):
+    chaos_kind = policy.TRANSIENT
+
+
+class ChaosResource(ChaosError):
+    chaos_kind = policy.RESOURCE
+
+
+class ChaosPermanent(ChaosError):
+    chaos_kind = policy.PERMANENT
+
+
+_EXC = {policy.TRANSIENT: ChaosTransient, policy.RESOURCE: ChaosResource,
+        policy.PERMANENT: ChaosPermanent}
+
+
+class _Spec:
+    """One parsed ``site:kind:schedule`` clause."""
+
+    def __init__(self, site: str, kind: str, sched: str):
+        if kind not in policy.KINDS:
+            raise ValueError(
+                f"chaos kind must be one of {policy.KINDS}, got {kind!r}")
+        self.site = site
+        self.kind = kind
+        self.sched = sched
+        self._preds = [self._parse_one(tok.strip())
+                       for tok in sched.split(",") if tok.strip()]
+        if not self._preds:
+            raise ValueError(f"empty chaos schedule for site {site!r}")
+
+    def _parse_one(self, tok: str):
+        site = self.site
+        if tok.startswith("p"):  # pP@SEED probabilistic, seeded
+            prob_s, _, seed_s = tok[1:].partition("@")
+            prob = float(prob_s)
+            seed = int(seed_s) if seed_s else 0
+
+            def prob_pred(n: int, prob=prob, seed=seed) -> bool:
+                h = zlib.crc32(f"{site}:{n}:{seed}".encode()) & 0xFFFFFFFF
+                return (h / 2**32) < prob
+
+            return prob_pred
+        if tok.startswith("%"):  # every Kth hit
+            k = int(tok[1:])
+            if k <= 0:
+                raise ValueError(f"chaos schedule %K needs K >= 1: {tok!r}")
+            return lambda n, k=k: n % k == 0
+        if tok.endswith("+"):  # from N on
+            lo = int(tok[:-1])
+            return lambda n, lo=lo: n >= lo
+        if "-" in tok:  # range N-M
+            lo_s, _, hi_s = tok.partition("-")
+            lo, hi = int(lo_s), int(hi_s)
+            return lambda n, lo=lo, hi=hi: lo <= n <= hi
+        target = int(tok)  # exactly the Nth hit
+        return lambda n, target=target: n == target
+
+    def fires(self, n: int) -> bool:
+        return any(p(n) for p in self._preds)
+
+
+class ChaosPlan:
+    """An armed set of specs with per-site hit counters (lock-guarded:
+    sites are hit from serving threads too)."""
+
+    def __init__(self, cfg: str):
+        self.cfg = cfg
+        self.specs: List[_Spec] = []
+        for clause in cfg.split(";"):
+            clause = clause.strip()
+            if not clause:
+                continue
+            parts = clause.split(":", 2)
+            if len(parts) != 3:
+                raise ValueError(
+                    f"chaos clause must be site:kind:schedule, got "
+                    f"{clause!r}")
+            self.specs.append(_Spec(*[p.strip() for p in parts]))
+        self._sites = {s.site for s in self.specs}
+        self._lock = threading.Lock()
+        self._hits: Dict[str, int] = {}
+        self.fired: List[tuple] = []  # [(site, hit_index, kind)] audit log
+
+    def hits(self, site: str) -> int:
+        with self._lock:
+            return self._hits.get(site, 0)
+
+    def check(self, site: str) -> None:
+        if site not in self._sites:
+            return  # unscripted sites don't even count
+        with self._lock:
+            n = self._hits.get(site, 0) + 1
+            self._hits[site] = n
+            fire = next((s for s in self.specs
+                         if s.site == site and s.fires(n)), None)
+            if fire is not None:
+                self.fired.append((site, n, fire.kind))
+        if fire is None:
+            return
+        from ..observability.metrics import REGISTRY
+        from ..observability import trace
+
+        REGISTRY.counter(
+            "chaos_injections_total", "Faults injected by site and kind",
+        ).labels(site=site, kind=fire.kind).inc()
+        trace.instant("chaos_injection", site=site, hit=n, kind=fire.kind)
+        raise _EXC[fire.kind](site, n)
+
+
+_lock = threading.Lock()
+_plan: Optional[ChaosPlan] = None  # programmatic override (configure())
+_env_plan: Optional[ChaosPlan] = None  # parsed-env cache, keyed by cfg str
+
+
+def active_plan() -> Optional[ChaosPlan]:
+    """The armed plan: a ``configure()`` override wins, else the parsed
+    ``XGBTPU_CHAOS`` env (re-parsed whenever the string changes, so tests
+    can flip it without reimports). None when chaos is off."""
+    global _env_plan
+    if _plan is not None:
+        return _plan
+    cfg = os.environ.get(_ENV)
+    if not cfg:
+        return None
+    with _lock:
+        if _env_plan is None or _env_plan.cfg != cfg:
+            _env_plan = ChaosPlan(cfg)
+        return _env_plan
+
+
+def hit(site: str) -> None:
+    """Injection point. No-op (one global read) unless a plan is armed."""
+    if _plan is None and _ENV not in os.environ:
+        return
+    plan = active_plan()
+    if plan is not None:
+        plan.check(site)
+
+
+@contextlib.contextmanager
+def configure(cfg: str) -> Iterator[ChaosPlan]:
+    """Arm a chaos plan for the enclosed block (tests). Yields the plan so
+    callers can inspect ``plan.fired`` / ``plan.hits(site)``."""
+    global _plan
+    plan = ChaosPlan(cfg)
+    with _lock:
+        prev, _plan = _plan, plan
+    try:
+        yield plan
+    finally:
+        with _lock:
+            _plan = prev
+
+
+def reset() -> None:
+    """Drop any armed plan and the env-parse cache (tests)."""
+    global _plan, _env_plan
+    with _lock:
+        _plan = None
+        _env_plan = None

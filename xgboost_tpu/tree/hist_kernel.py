@@ -33,7 +33,6 @@ non-TPU backends (CPU tests, virtual-device dryruns) via segment_sum.
 from __future__ import annotations
 
 import functools
-import threading
 from typing import Optional, Tuple
 
 import jax
@@ -41,6 +40,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..analysis.retrace import guard_jit
+from ..resilience.degrade import OneShot
 
 __all__ = [
     "fused_level", "fused_level_xla", "partition_apply_xla", "leaf_delta",
@@ -122,17 +122,48 @@ def hoist_plan_synced(n_pad: int, F: int, B: int, max_depth: int = 6) -> int:
     return fh
 
 
-# one-shot allocation-probe result: None until probed (or probe failed);
-# module-level so every hoist_plan of the session reuses the measurement.
-# Lock-guarded (lint CC402): two threads racing the unguarded check-then-
-# set would BOTH run the multi-second bisection, concurrently allocating
-# multi-GB device buffers — exactly the OOM the probe exists to avoid.
-_probed_free_bytes: Optional[int] = None
-_probe_done = False
-_probe_lock = threading.Lock()
+# one-shot allocation probe, memoized in the resilience layer's OneShot
+# (the lock-guarded run-once that replaced the module-level probe flag
+# pair): two threads racing an unguarded check-then-set would BOTH run
+# the multi-second bisection, concurrently allocating multi-GB device
+# buffers — exactly the OOM the probe exists to avoid.
+_probe = OneShot("hbm_probe")
 
 _PROBE_HI = 16 * 1024 * 1024 * 1024  # the AOT compiler's enforced ceiling
 _PROBE_STEP = 256 * 1024 * 1024  # resolution: 6 bisection steps from 16 GiB
+
+
+def _probe_free_bytes_impl() -> Optional[int]:
+    if jax.default_backend() != "tpu":
+        return None
+
+    def fits(nbytes: int) -> bool:
+        try:
+            a = jnp.zeros((nbytes,), jnp.uint8)
+            a.block_until_ready()
+            a.delete()
+            return True
+        except Exception:
+            return False
+
+    lo, hi = 0, _PROBE_HI  # invariant: lo fits (0 trivially), hi may not
+    try:
+        while hi - lo > _PROBE_STEP:
+            mid = (lo + hi) // 2
+            if fits(mid):
+                lo = mid
+            else:
+                hi = mid
+    except Exception:
+        return None
+    if lo <= 0:
+        return None
+    from ..utils import console_logger
+
+    console_logger.info(
+        f"device memory probe: largest releasable allocation "
+        f"{lo // (1024 * 1024)} MB (memory_stats unavailable)")
+    return lo
 
 
 def probe_free_bytes() -> Optional[int]:
@@ -142,46 +173,10 @@ def probe_free_bytes() -> Optional[int]:
     step allocates on-device zeros (no host transfer), syncs, and deletes —
     seconds total, vs the OOM-driven retry ladder that burned measurement
     windows. TPU-only: a CPU 'probe' would just thrash host RAM. The result
-    is cached for the process (None when probing is unavailable/failed).
-    The lock makes the one-shot real: a second thread arriving mid-probe
-    waits for the measurement instead of launching a concurrent multi-GB
-    bisection of its own."""
-    global _probed_free_bytes, _probe_done
-    with _probe_lock:
-        if _probe_done:
-            return _probed_free_bytes
-        _probe_done = True
-        if jax.default_backend() != "tpu":
-            return None
-
-        def fits(nbytes: int) -> bool:
-            try:
-                a = jnp.zeros((nbytes,), jnp.uint8)
-                a.block_until_ready()
-                a.delete()
-                return True
-            except Exception:
-                return False
-
-        lo, hi = 0, _PROBE_HI  # invariant: lo fits (0 trivially), hi may not
-        try:
-            while hi - lo > _PROBE_STEP:
-                mid = (lo + hi) // 2
-                if fits(mid):
-                    lo = mid
-                else:
-                    hi = mid
-        except Exception:
-            return None
-        if lo <= 0:
-            return None
-        _probed_free_bytes = lo
-        from ..utils import console_logger
-
-        console_logger.info(
-            f"device memory probe: largest releasable allocation "
-            f"{lo // (1024 * 1024)} MB (memory_stats unavailable)")
-        return _probed_free_bytes
+    is memoized for the process (None when probing is unavailable/failed);
+    a second thread arriving mid-probe waits for the measurement instead
+    of launching a concurrent multi-GB bisection of its own."""
+    return _probe.run(_probe_free_bytes_impl)
 
 
 def hoist_budget_bytes() -> int:

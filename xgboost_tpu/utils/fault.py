@@ -89,7 +89,14 @@ def inject(site: str) -> None:
     """Injection site: no-op unless a spec is armed and the current
     (version, seqno) has remaining trials. Sites are the per-round host
     dispatch boundaries (gradient/grow/eval) — the places the reference
-    mock intercepts collectives."""
+    mock intercepts collectives. These boundaries double as chaos sites of
+    the same names: ``resilience/chaos.py`` generalizes this scripted
+    (version, seqno) mock into named-site schedules, and bridging here
+    means ``XGBTPU_CHAOS="grow:transient:3"`` can kill round dispatch
+    without arming a fault spec."""
+    from ..resilience import chaos
+
+    chaos.hit(site)
     spec = getattr(_state, "spec", None)
     if spec is None:
         return
