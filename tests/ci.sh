@@ -46,23 +46,50 @@ export XGBTPU_TRACE="$TRACE_OUT"
 # sidesteps it — and since round 5 the SPLIT halves hit the flake too
 # (VERDICT weak #6), each half gets a bounded retry that absorbs ONLY
 # crash exits (signal deaths: rc >= 128, e.g. 139=SIGSEGV, 134=SIGABRT).
-# A real test failure (rc 1) or collection error fails immediately and a
-# crash that persists across 3 attempts fails loudly — retries never mask
-# a deterministic problem.
+# On a crash retry the half is re-sharded into QUARTERS (halving the
+# per-process compile volume again) and the native build cache is
+# cleared (a .so half-written by the crashed process must not poison the
+# rebuild). Every retry prints a "RETRIED:" line so a probabilistically-
+# green run is visible in the log instead of silent. A real test failure
+# (rc 1) or collection error fails immediately and a crash that persists
+# across 3 attempts fails loudly — retries never mask a deterministic
+# problem.
 run_half() {
   local label="$1"; shift
-  local attempt rc
+  local files=("$@")
+  local attempt rc mid
   for attempt in 1 2 3; do
     set +e
-    python -m pytest "$@" -x -q -m 'not slow'
-    rc=$?
+    if [ "$attempt" -eq 1 ]; then
+      python -m pytest "${files[@]}" -x -q -m 'not slow'
+      rc=$?
+    else
+      rm -f xgboost_tpu/native/*.so
+      mid=$(( (${#files[@]} + 1) / 2 ))
+      rc=0
+      local quarter
+      for quarter in 0 1; do
+        if [ "$quarter" -eq 0 ]; then
+          python -m pytest "${files[@]:0:$mid}" -x -q -m 'not slow'
+        else
+          python -m pytest "${files[@]:$mid}" -x -q -m 'not slow'
+        fi
+        rc=$?
+        [ "$rc" -ne 0 ] && break
+      done
+    fi
     set -e
     if [ "$rc" -eq 0 ]; then
+      if [ "$attempt" -gt 1 ]; then
+        echo "RETRIED: $label went green on attempt $attempt/3 (crash" \
+             "retry: native cache cleared, re-sharded into quarters)"
+      fi
       return 0
     fi
     if [ "$rc" -ge 128 ]; then
-      echo "=== $label crashed (rc=$rc, XLA:CPU compile flake) on" \
-           "attempt $attempt/3 — retrying ==="
+      echo "RETRIED: $label crashed (rc=$rc, XLA:CPU compile flake) on" \
+           "attempt $attempt/3 — clearing native cache and re-sharding" \
+           "into quarters"
     else
       echo "=== $label FAILED (rc=$rc): real test failure, no retry ==="
       return "$rc"
@@ -117,6 +144,45 @@ assert 'degrade_state{capability="pallas_predict"}' in exp
 assert 'degrade_state{capability="onehot_build"}' in exp
 print(f"chaos smoke OK: {len(plan.fired)} injected faults absorbed, "
       "fault history in exposition")
+EOF
+
+echo "=== tier 1.6: elastic chaos lane (seeded worker_kill) ==="
+# A 2-process gloo training run with XGBTPU_CHAOS="worker_kill:..." armed
+# on rank 1: the scripted SIGKILL mid-round must drive the full elastic
+# path — heartbeat detection -> quiesce at the round boundary -> resize
+# 2 -> 1 -> checkpoint replay to completion — and the elastic metrics
+# must land in the survivor's exposition (docs/distributed.md).
+python - <<'EOF'
+import json, os, signal, socket, subprocess, sys, tempfile
+
+s = socket.socket(); s.bind(("localhost", 0))
+port = s.getsockname()[1]; s.close()
+outdir = tempfile.mkdtemp(prefix="ci_elastic_")
+worker = os.path.join("tests", "elastic_worker.py")
+procs = []
+for r in (0, 1):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.getcwd() + os.pathsep + env.get("PYTHONPATH", "")
+    if r == 1:
+        env["XGBTPU_CHAOS"] = "worker_kill:permanent:2"  # 2nd round boundary
+    procs.append(subprocess.Popen(
+        [sys.executable, worker, str(r), str(port), outdir, "5"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True))
+outs = [p.communicate(timeout=420)[0] for p in procs]
+assert procs[1].returncode == -signal.SIGKILL, \
+    f"rank1 not SIGKILLed:\n{outs[1][-2000:]}"
+assert procs[0].returncode == 0, f"survivor failed:\n{outs[0][-4000:]}"
+assert "resizing world 2 -> 1" in outs[0], outs[0][-2000:]
+meta = json.load(open(os.path.join(outdir, "meta_rank0.json")))
+assert meta["rounds"] == 5, meta
+prom = open(os.path.join(outdir, "metrics_rank0.prom")).read()
+for needle in ("membership_changes_total 1", "worker_restarts_total 1",
+               "elastic_resume_rounds_replayed",
+               'worker_alive{rank="1"} 0', 'faults_total'):
+    assert needle in prom, f"missing {needle!r} in elastic exposition"
+print("elastic chaos lane OK: detection -> quiesce -> resize -> replay, "
+      "metrics exported")
 EOF
 
 echo "=== tier 2: trace parses as Chrome trace JSON ==="

@@ -19,7 +19,7 @@ from .data.dmatrix import DMatrix, QuantileDMatrix, load_row_split  # noqa: F401
 from .utils.timer import profiler_context  # noqa: F401
 from .data.external import ExternalMemoryQuantileDMatrix  # noqa: F401
 from .learner import Booster  # noqa: F401
-from .training import cv, train  # noqa: F401
+from .training import cv, elastic_exit, elastic_train, train  # noqa: F401
 from .plotting import plot_importance, plot_tree, to_graphviz  # noqa: F401
 from .data.iterator import DataIter  # noqa: F401
 

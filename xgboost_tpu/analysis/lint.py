@@ -72,7 +72,21 @@ ALL_RULES = {
     "CC401": "module-level mutable state mutated outside a lock",
     "CC402": "global rebound outside a lock",
     "CC403": "module-level fallback latch outside resilience/degrade.py",
+    "RS501": "direct collective call site outside collective.py",
 }
+
+# RS501: every collective must route through the guarded entry point
+# (``collective.guarded``/``process_allgather`` for host-side calls,
+# ``collective.psum``/``all_gather`` for traced in-program ones) so that
+# deadlines, retry classification and the elastic worker-loss signal
+# apply uniformly — a stray ``lax.psum`` is a site that hangs or raises
+# raw RuntimeError when a peer dies (same fencing pattern as CC403).
+_RS501_NAMES = {"psum", "psum_scatter", "all_gather", "all_to_all",
+                "pbroadcast", "ppermute", "pmean", "pmax", "pmin",
+                "process_allgather", "broadcast_one_to_all",
+                "sync_global_devices"}
+_RS501_ROOTS = {"jax", "lax", "multihost_utils"}
+_RS501_EXEMPT = "collective.py"
 
 # CC403: module-level names that read as fallback latches (broken/failed/
 # blocked/... flags and blacklist dicts). Capability state belongs in the
@@ -962,6 +976,38 @@ def _pass_concurrency(project: _Project) -> List[Finding]:
     return out
 
 
+def _pass_collectives(project: _Project) -> List[Finding]:
+    """RS501: direct ``lax.psum``/``all_gather``/``process_allgather``/...
+    call sites anywhere but ``collective.py`` (the guarded entry point).
+    Matched on the attribute chain, so wrapper calls
+    (``collective.psum``) never fire and shape ops that merely contain
+    the words (``broadcast_to``, ``broadcasted_iota``) never fire."""
+    out: List[Finding] = []
+    for mod in project.modules:
+        if mod.in_package and mod.relpath.endswith(
+                "xgboost_tpu/" + _RS501_EXEMPT):
+            continue
+        symbols = _symbol_index(mod)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = _attr_chain(node.func)
+            if not chain or chain[-1] not in _RS501_NAMES:
+                continue
+            if chain[0] not in _RS501_ROOTS:
+                continue
+            out.append(Finding(
+                "RS501", mod.relpath, node.lineno,
+                symbols.get(node.lineno, "<module>"),
+                f"direct collective '{'.'.join(chain)}' outside "
+                f"collective.py: route host-side calls through "
+                f"collective.guarded/process_allgather and traced ones "
+                f"through collective.psum/all_gather, so deadlines, "
+                f"retry classification and the elastic worker-loss "
+                f"signal apply"))
+    return out
+
+
 # ---------------------------------------------------------------------------
 # entry points
 # ---------------------------------------------------------------------------
@@ -986,6 +1032,7 @@ def lint_paths(paths: Optional[Sequence[str]] = None,
     findings += _pass_retrace_hygiene(project)
     findings += _pass_dtype(project)
     findings += _pass_concurrency(project)
+    findings += _pass_collectives(project)
     if rules:
         findings = [f for f in findings if f.rule in rules]
     # dedupe (two detection routes can hit the same node)

@@ -5,6 +5,7 @@ key=value config parser (``src/common/config.h``). Usage:
 
     python -m xgboost_tpu <config> [key=value ...]
     python -m xgboost_tpu trace-report <trace-file> [--top N]
+    python -m xgboost_tpu checkpoint-inspect <dir>
 
 Config keys mirror the reference: task, data, test:data, model_in,
 model_out, model_dir, num_round, save_period, eval[name]=path, dump_format,
@@ -15,6 +16,11 @@ spans by self time, per-rank totals — ``docs/observability.md``).
 concurrency passes, ``docs/static_analysis.md``):
 
     python -m xgboost_tpu lint [paths...] [--baseline F] [--write-baseline]
+
+``checkpoint-inspect`` lists a resume directory's checkpoints (round,
+size, checksum-verify status) and marks the newest verified one — the
+snapshot ``train(resume_from=...)`` / elastic replay would pick up
+(``docs/resilience.md``). Exit status 1 when nothing verifies.
 """
 
 from __future__ import annotations
@@ -80,6 +86,8 @@ def cli_main(argv: List[str]) -> int:
         from .analysis.cli import main as lint_main
 
         return lint_main(argv[1:])
+    if argv[0] == "checkpoint-inspect":
+        return checkpoint_inspect_main(argv[1:])
     pairs = parse_config_file(argv[0])
     for extra in argv[1:]:
         k, _, v = extra.partition("=")
@@ -135,6 +143,35 @@ def cli_main(argv: List[str]) -> int:
         print(f"unknown task: {task}", file=sys.stderr)
         return 1
     return 0
+
+
+def checkpoint_inspect_main(argv: List[str]) -> int:
+    """``checkpoint-inspect <dir>``: the operator-facing read side of
+    ``resume_from`` — what is on disk, what verifies, what a resume
+    would actually load."""
+    if not argv or argv[0].startswith("-"):
+        print("usage: python -m xgboost_tpu checkpoint-inspect <dir>",
+              file=sys.stderr)
+        return 1
+    from .resilience.checkpoint import inspect_dir
+
+    directory = argv[0]
+    records = inspect_dir(directory)
+    if not records:
+        print(f"{directory}: no checkpoints found")
+        return 1
+    print(f"{'':2} {'round':>8} {'bytes':>12} {'status':<40} path")
+    any_ok = False
+    for rec in records:
+        mark = "*" if rec["newest_verified"] else " "
+        status = "verified" if rec["verified"] else \
+            f"CORRUPT: {rec['detail']}"
+        any_ok = any_ok or rec["verified"]
+        print(f"{mark:2} {rec['rounds']:>8} {rec['bytes']:>12} "
+              f"{status:<40} {rec['path']}")
+    print("\n'*' = newest verified (what train(resume_from=...) / "
+          "elastic replay loads)")
+    return 0 if any_ok else 1
 
 
 def main() -> None:  # console entry

@@ -1,7 +1,7 @@
-"""Collective-communication compatibility shim (reference:
-``python-package/xgboost/rabit.py`` and its successor
+"""Collective-communication layer: the reference API shim (rabit.py /
 ``xgboost/collective.py`` — init/finalize, rank/world queries, allreduce,
-broadcast, tracker print).
+broadcast, tracker print) PLUS the package's single guarded entry point
+for every host-side collective.
 
 There is no rabit ring here: JAX's single-controller runtime IS the
 communicator (``jax.distributed`` for membership, mesh collectives for
@@ -10,18 +10,149 @@ API shape working for ported user code: queries map onto
 ``jax.process_index/process_count``, ``allreduce`` runs a psum over a
 1-axis mesh of all devices, and ``init``/``finalize`` are no-ops when the
 runtime is already up (the common case under ``init_distributed``).
+
+**Guarded entry point** (elastic-training tentpole): every host-side
+collective in the package — the ``multihost_utils.process_allgather``
+helpers behind row padding, hoist planning, metric reduction and the
+rabit-shim allreduce/broadcast — routes through :func:`guarded`, which
+applies, in order:
+
+- the ``collective`` / ``collective_timeout`` chaos sites (seeded,
+  deterministic fault injection — ``resilience/chaos.py``);
+- a per-site deadline (``XGBTPU_WATCHDOG="collective_<site>=S"`` or the
+  ``collective=S`` wildcard; ``resilience/watchdog.py``) so a wedged
+  rendezvous aborts cleanly instead of hanging the run;
+- bounded retry with ``resilience.policy`` classification
+  (``XGBTPU_RETRY="collective_<site>=N"``; default 0 — a one-sided retry
+  of a cross-process op desyncs SPMD lockstep, so recovery from real peer
+  loss belongs to the elastic resize layer, not in-place retries);
+- on exhaustion, a typed :class:`CollectiveError` carrying the classified
+  kind and a ``worker_lost`` verdict (``policy.is_worker_loss``) instead
+  of a raw RuntimeError — the signal ``elastic_train`` keys on.
+
+Device-side collectives (the psums *inside* compiled programs) cannot be
+host-guarded per op; they route through the traced helpers :func:`psum`
+and :func:`all_gather` so every call site is centralized here (lint rule
+RS501 fences strays), and their failure surfaces at the dispatch site,
+which the per-round watchdog + elastic layer guard.
 """
 
 from __future__ import annotations
 
 from enum import IntEnum
-from typing import Optional
+from typing import Callable, Optional
 
 import numpy as np
 
 __all__ = ["Op", "init", "finalize", "get_rank", "get_world_size",
            "is_distributed", "allreduce", "broadcast", "communicator_print",
-           "get_processor_name", "tracker_print", "version_number"]
+           "get_processor_name", "tracker_print", "version_number",
+           "CollectiveError", "guarded", "process_allgather", "psum",
+           "all_gather"]
+
+#: default deadline (seconds) for one guarded host-side collective; a
+#: healthy allgather completes in milliseconds-to-seconds, so ten minutes
+#: means "wedged" — override per site via XGBTPU_WATCHDOG.
+DEFAULT_DEADLINE = 600.0
+
+
+class CollectiveError(RuntimeError):
+    """A guarded collective failed after classification and (bounded)
+    retries. ``kind`` is the ``resilience.policy`` classification of the
+    final failure; ``worker_lost`` is True when the failure signature
+    reads as a dead peer (connection closed/reset, gloo ring break) —
+    the trigger for elastic resize rather than plain retry."""
+
+    def __init__(self, site: str, kind: str, cause: BaseException,
+                 worker_lost: bool = False):
+        super().__init__(
+            f"collective {site!r} failed ({kind}"
+            + (", peer loss" if worker_lost else "")
+            + f"): {type(cause).__name__}: {cause}")
+        self.site = site
+        self.kind = kind
+        self.cause = cause
+        self.worker_lost = worker_lost
+
+
+def guarded(site: str, fn: Callable, *args, nbytes: int = 0,
+            n_ops: int = 1, op: Optional[str] = None):
+    """THE guarded entry point for host-side collectives: run ``fn(*args)``
+    under chaos injection, a per-site deadline and the bounded retry
+    policy; account the payload under ``op`` (default: the site name).
+    Raises :class:`CollectiveError` instead of raw runtime errors."""
+    from .observability import comms
+    from .resilience import policy
+    from .resilience.chaos import ChaosError
+    from .resilience.watchdog import deadline_for, watchdog
+
+    # accounting doubles as the `collective` chaos site (PR 4 contract:
+    # every accounted collective passes comms.record)
+    comms.record(op or site, nbytes, n_ops=n_ops)
+    qsite = f"collective_{site}"
+    deadline = deadline_for(qsite, deadline_for("collective",
+                                                DEFAULT_DEADLINE))
+
+    def attempt():
+        from .resilience import chaos
+
+        # scripted deadline expiry: fires as a transient fault at this
+        # exact site, exercising the timeout path without wall clock
+        chaos.hit("collective_timeout")
+        with watchdog(qsite, seconds=deadline):
+            return fn(*args)
+
+    try:
+        return policy.RetryPolicy(qsite, retries=0).run(attempt)
+    except ChaosError as e:
+        raise CollectiveError(site, e.chaos_kind, e,
+                              policy.is_worker_loss(e)) from e
+    except Exception as e:
+        raise CollectiveError(site, policy.classify(e), e,
+                              policy.is_worker_loss(e)) from e
+
+
+def process_allgather(data, *, site: str):
+    """Guarded ``multihost_utils.process_allgather``: one contribution per
+    process, stacked along a leading ``[P, ...]`` axis, as numpy. The one
+    route by which host code gathers across processes — every caller
+    (row padding, hoist planning, metric reduction, the rabit shim) names
+    its site so deadlines/retries/faults are attributable."""
+    arr = np.asarray(data)
+
+    def run():
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(arr))
+
+    return guarded(site, run, nbytes=int(arr.nbytes),
+                   op="process_allgather")
+
+
+# ---------------------------------------------------------------------------
+# traced helpers: device-side collectives inside compiled programs. These
+# stage INTO the program (zero host cost per execution) — they exist so
+# every in-kernel collective call site routes through this module (RS501)
+# and so `axis_name=None` uniformly means "single-shard identity".
+# ---------------------------------------------------------------------------
+
+
+def psum(x, axis_name: Optional[str]):
+    """Traced AllReduce(sum) over ``axis_name``; identity when None."""
+    if axis_name is None:
+        return x
+    import jax
+
+    return jax.lax.psum(x, axis_name)
+
+
+def all_gather(x, axis_name: Optional[str], **kwargs):
+    """Traced all-gather over ``axis_name``; identity when None."""
+    if axis_name is None:
+        return x
+    import jax
+
+    return jax.lax.all_gather(x, axis_name, **kwargs)
 
 
 class Op(IntEnum):
@@ -65,19 +196,15 @@ def get_processor_name() -> str:
 
 def allreduce(data: np.ndarray, op: int = Op.SUM) -> np.ndarray:
     """AllReduce with one contribution per PROCESS (the reference's rabit
-    semantics): allgather each process's value through the distributed
-    runtime, reduce on host. Identity when single-process."""
+    semantics): allgather each process's value through the guarded entry
+    point, reduce on host. Identity when single-process."""
     arr = np.asarray(data)
     if get_world_size() == 1:
         return arr
-    from jax.experimental import multihost_utils
-
-    from .observability import comms, trace
+    from .observability import trace
 
     with trace.span("allreduce", bytes=int(arr.nbytes), op=int(op)):
-        gathered = np.asarray(
-            multihost_utils.process_allgather(arr))  # [P,...]
-    comms.record("allreduce", int(arr.nbytes))
+        gathered = process_allgather(arr, site="allreduce")  # [P,...]
     red = {Op.SUM: np.sum, Op.MAX: np.max, Op.MIN: np.min}[Op(op)]
     return red(gathered, axis=0)
 
@@ -86,27 +213,23 @@ def broadcast(data, root: int):
     """Reference collective.py:broadcast — ship ``root``'s value to every
     process. Ranks can legitimately hold different values (a rank-0-loaded
     model, a locally computed threshold), so this must actually move data:
-    allgather every process's pickled payload through the distributed
-    runtime and select the root's entry. Identity when single-process."""
+    allgather every process's pickled payload through the guarded entry
+    point and select the root's entry. Identity when single-process."""
     if get_world_size() == 1:
         return data
     import pickle
 
-    from jax.experimental import multihost_utils
-
-    from .observability import comms, trace
+    from .observability import trace
 
     payload = np.frombuffer(pickle.dumps(data), dtype=np.uint8)
     with trace.span("broadcast", bytes=int(payload.size), root=root):
         # Fixed-size buffer: allgather needs equal shapes across processes.
-        sizes = multihost_utils.process_allgather(
-            np.asarray([payload.size], np.int64))
+        sizes = process_allgather(np.asarray([payload.size], np.int64),
+                                  site="broadcast")
         cap = int(np.max(sizes))
         buf = np.zeros(cap, np.uint8)
         buf[: payload.size] = payload
-        gathered = np.asarray(
-            multihost_utils.process_allgather(buf))  # [P,cap]
-    comms.record("broadcast", cap + 8, n_ops=2)
+        gathered = process_allgather(buf, site="broadcast")  # [P,cap]
     root_size = int(np.asarray(sizes).ravel()[root])
     return pickle.loads(gathered[root, :root_size].tobytes())
 

@@ -415,10 +415,11 @@ class BinnedMatrix:
                 # (e.g. an asymmetric OOM), all ranks drop to construct
                 import numpy as _np
 
-                from jax.experimental import multihost_utils
+                from .. import collective
 
-                ok_all = _np.asarray(multihost_utils.process_allgather(
-                    _np.asarray(0 if oh is None else 1, _np.int64)))
+                ok_all = collective.process_allgather(
+                    _np.asarray(0 if oh is None else 1, _np.int64),
+                    site="onehot_agree")
                 if int(ok_all.min()) == 0 and oh is not None:
                     # a peer rank's asymmetric failure is a resource
                     # problem for the whole SPMD program: disable here too

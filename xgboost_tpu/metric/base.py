@@ -29,10 +29,10 @@ def dist_reduce(s: float, w: float) -> Tuple[float, float]:
 
     if not collective_active():
         return s, w
-    from jax.experimental import multihost_utils
+    from .. import collective
 
-    arr = np.asarray(multihost_utils.process_allgather(
-        np.asarray([s, w], np.float64)))
+    arr = collective.process_allgather(
+        np.asarray([s, w], np.float64), site="metric_reduce")
     return float(arr[:, 0].sum()), float(arr[:, 1].sum())
 
 

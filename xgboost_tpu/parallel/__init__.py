@@ -1,11 +1,13 @@
 from .mesh import (  # noqa: F401
     current_mesh,
+    form_world,
     init_distributed,
     make_mesh,
     mesh_context,
     pad_to_multiple,
     shard_rows,
 )
+from .membership import Membership, WorkerLost  # noqa: F401
 from .grow import (  # noqa: F401
     distributed_grow_tree,
     distributed_grow_tree_fused,

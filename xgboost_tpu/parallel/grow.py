@@ -231,10 +231,10 @@ def distributed_boost_rounds_scan(
         # prefix under load_row_split ingestion), plus explicit replication
         # of the small operands: multi-process programs only accept global
         # arrays
-        from jax.experimental import multihost_utils
+        from .. import collective
 
-        n_arr = jnp.asarray(
-            multihost_utils.process_allgather(np.asarray(n, np.int32)))
+        n_arr = jnp.asarray(collective.process_allgather(
+            np.asarray(n, np.int32), site="row_counts"))
         rep = lambda x: None if x is None else replicate(  # noqa: E731
             jnp.asarray(x), mesh)
         iters, cut_values, eta, gamma, feature_weights, seed_base, n_arr = (

@@ -1,0 +1,353 @@
+"""File-based worker membership + heartbeat protocol for elastic training.
+
+The reference's rabit tracker knows which workers exist and restarts the
+dead ones; JAX's single-controller runtime has no such organ — its
+coordination service LOG(FATAL)s the survivors when it notices a death
+(xla distributed client), which is exactly wrong for elasticity. This
+module supplies the missing organ at the file-system level (a shared
+directory — local disk for one host, NFS/GCS-fuse for a pod), so it works
+identically under every transport and needs no extra server:
+
+- every worker runs a tiny **heartbeat agent subprocess** writing
+  ``<dir>/rank<r>.hb`` (JSON: rank, pid, generation, seq) every
+  ``XGBTPU_HEARTBEAT`` seconds (default 1.0). An agent PROCESS, not a
+  thread, deliberately: a worker wedged inside a blocking collective can
+  sit in C++ holding the GIL for tens of seconds, and thread-based beats
+  stop exactly when liveness matters most — measured here as two healthy
+  survivors tombstoning each other mid-gloo-stall. The agent's beats
+  reflect only true process liveness: it exits within one interval of
+  its parent dying (reparenting check), so SIGKILL stops the beats and
+  nothing else does;
+- a daemon **monitor** thread in the worker scans peers: a rank whose
+  ``seq`` has not moved for ``XGBTPU_HEARTBEAT_DEADLINE`` seconds
+  (default 5x interval) is declared dead — loss is detected within one
+  deadline, per the elastic contract;
+- detection is **observable**: ``worker_alive{rank=...}`` gauges, a
+  ``membership_changes_total`` counter and trace instants on every
+  transition;
+- a detected death is made **durable** with a ``rank<r>.dead`` tombstone
+  so re-formed generations and restarted processes agree on membership
+  without re-timing-out; a live worker that finds its own tombstone is
+  FENCED (it lost a partition dispute) and must exit rather than split-
+  brain the run — ``Membership.fenced`` flags it;
+- the ``heartbeat_drop`` chaos site skips scripted beats, exercising both
+  detection and false-positive tolerance deterministically in CI.
+
+Liveness is judged by sequence-number movement against the local
+monotonic clock, never by comparing file mtimes across hosts (shared
+filesystems make no cross-host clock promises).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+__all__ = ["Membership", "WorkerLost", "hb_interval", "hb_deadline"]
+
+_ENV_INTERVAL = "XGBTPU_HEARTBEAT"
+_ENV_DEADLINE = "XGBTPU_HEARTBEAT_DEADLINE"
+
+# The heartbeat agent: runs as a direct child of the worker, beats while
+# (and only while) the parent lives. STDLIB-ONLY on purpose — importing
+# the package (and with it jax) would delay the first beat by seconds,
+# longer than a tight test deadline. It therefore carries its own copy of
+# the chaos schedule predicate for the ``heartbeat_drop`` site (same
+# grammar and crc32(site:hit:seed) hash as resilience/chaos.py — the
+# cross-process determinism test in tests/test_elastic.py pins that
+# contract; keep the two in sync).
+_AGENT_SRC = r"""
+import json, os, sys, time, zlib
+path = sys.argv[1]
+rank = int(sys.argv[2])
+gen = int(sys.argv[3])
+interval = float(sys.argv[4])
+ppid = int(sys.argv[5])
+
+SITE = "heartbeat_drop"
+
+
+def _preds(cfg):
+    out = []
+    for clause in (cfg or "").split(";"):
+        parts = [p.strip() for p in clause.split(":", 2)]
+        if len(parts) != 3 or parts[0] != SITE:
+            continue
+        for tok in parts[2].split(","):
+            tok = tok.strip()
+            if not tok:
+                continue
+            try:
+                if tok.startswith("p"):
+                    ps, _, ss = tok[1:].partition("@")
+                    prob, seed = float(ps), int(ss) if ss else 0
+                    out.append(lambda n, p=prob, s=seed: (zlib.crc32(
+                        ("%s:%d:%d" % (SITE, n, s)).encode())
+                        & 0xFFFFFFFF) / 2**32 < p)
+                elif tok.startswith("%"):
+                    out.append(lambda n, k=int(tok[1:]): n % k == 0)
+                elif tok.endswith("+"):
+                    out.append(lambda n, lo=int(tok[:-1]): n >= lo)
+                elif "-" in tok:
+                    lo, _, hi = tok.partition("-")
+                    out.append(lambda n, lo=int(lo), hi=int(hi):
+                               lo <= n <= hi)
+                else:
+                    out.append(lambda n, t=int(tok): n == t)
+            except ValueError:
+                pass
+    return out
+
+
+preds = _preds(os.environ.get("XGBTPU_CHAOS"))
+seq = 0
+hit = 0
+while os.getppid() == ppid:
+    hit += 1
+    if not any(p(hit) for p in preds):
+        seq += 1
+        tmp = path + ".tmp." + str(os.getpid())
+        try:
+            with open(tmp, "w") as f:
+                f.write(json.dumps({"rank": rank, "pid": ppid,
+                                    "seq": seq, "generation": gen}))
+            os.replace(tmp, path)
+        except OSError:
+            pass
+    time.sleep(interval)
+"""
+
+
+def hb_interval() -> float:
+    """Heartbeat write/scan period in seconds (``XGBTPU_HEARTBEAT``)."""
+    try:
+        return max(0.05, float(os.environ.get(_ENV_INTERVAL, 1.0)))
+    except ValueError:
+        return 1.0
+
+
+def hb_deadline() -> float:
+    """Seconds of heartbeat silence that mean death
+    (``XGBTPU_HEARTBEAT_DEADLINE``, default 5x the interval — a couple of
+    dropped beats is jitter, five is a corpse)."""
+    try:
+        raw = os.environ.get(_ENV_DEADLINE)
+        if raw is not None:
+            return max(hb_interval(), float(raw))
+    except ValueError:
+        pass
+    return 5.0 * hb_interval()
+
+
+class WorkerLost(RuntimeError):
+    """One or more peers died (heartbeat silence or tombstone). Carries
+    the dead base ranks and the round at which loss was observed — the
+    signal the elastic training loop quiesces and resizes on."""
+
+    def __init__(self, ranks: List[int], round: int = -1):
+        super().__init__(
+            f"worker_lost: rank(s) {sorted(ranks)} dead"
+            + (f" (observed at round {round})" if round >= 0 else ""))
+        self.ranks = sorted(ranks)
+        self.round = round
+
+
+class Membership:
+    """Heartbeat writer + peer monitor for one worker.
+
+    ``rank`` is the worker's BASE rank — its identity for the life of the
+    elastic run, never renumbered by resizes (generation-local ranks are
+    the elastic layer's concern). ``peers`` is the base-rank set of the
+    current generation, this worker included.
+    """
+
+    def __init__(self, directory: str, rank: int, peers: List[int],
+                 generation: int = 0):
+        self.directory = directory
+        self.rank = int(rank)
+        self.peers = sorted(int(p) for p in peers)
+        self.generation = int(generation)
+        self.round = 0  # bumped by the training guard; exported in beats
+        os.makedirs(directory, exist_ok=True)
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._agent = None  # the heartbeat subprocess
+        # peer base rank -> [last_seq_seen, monotonic_when_seen]
+        self._seen: Dict[int, List[float]] = {}
+        self._dead: set = set()
+        self.fenced = False
+        self._grace_until = 0.0
+
+    # ------------------------------------------------------------------
+    # paths
+    # ------------------------------------------------------------------
+    def _hb_path(self, rank: int) -> str:
+        return os.path.join(self.directory, f"rank{rank}.hb")
+
+    def _tomb_path(self, rank: int) -> str:
+        return os.path.join(self.directory, f"rank{rank}.dead")
+
+    # ------------------------------------------------------------------
+    # writer: the out-of-process heartbeat agent
+    # ------------------------------------------------------------------
+    def _spawn_agent(self):
+        """Start the beat agent as a direct child. Beats continue while
+        this process lives — including through GIL-holding stalls inside
+        wedged collectives — and stop within one interval of it dying.
+        ``XGBTPU_CHAOS`` rides along in the inherited environment."""
+        import subprocess
+        import sys
+
+        return subprocess.Popen(
+            [sys.executable, "-c", _AGENT_SRC, self._hb_path(self.rank),
+             str(self.rank), str(self.generation), str(hb_interval()),
+             str(os.getpid())],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+    # ------------------------------------------------------------------
+    # monitor
+    # ------------------------------------------------------------------
+    def _read_seq(self, rank: int) -> Optional[int]:
+        try:
+            with open(self._hb_path(rank)) as f:
+                return int(json.load(f).get("seq", 0))
+        except (OSError, ValueError):
+            return None
+
+    def scan(self) -> List[int]:
+        """One monitoring pass: refresh peer liveness, publish the
+        ``worker_alive`` gauges, return the (possibly updated) dead set.
+        A peer is dead when tombstoned, or when its heartbeat sequence
+        has not moved for one deadline (missing files count from the
+        start of the grace window, so a peer that never comes up is
+        detected too)."""
+        from ..observability.metrics import REGISTRY
+        from ..observability import trace
+
+        now = time.monotonic()
+        deadline = hb_deadline()
+        newly_dead: List[int] = []
+        with self._lock:
+            for p in self.peers:
+                if p == self.rank:
+                    continue
+                if p in self._dead:
+                    continue
+                if os.path.exists(self._tomb_path(p)):
+                    self._dead.add(p)
+                    newly_dead.append(p)
+                    continue
+                seq = self._read_seq(p)
+                # a NEVER-seen peer gets a doubled allowance: its agent
+                # may still be forking/registering while ours already
+                # beats (startup skew must not read as death)
+                ent = self._seen.setdefault(
+                    p, [-1, (self._grace_until or now) + deadline])
+                if seq is not None and seq != ent[0]:
+                    ent[0], ent[1] = seq, now
+                elif now - ent[1] > deadline:
+                    self._dead.add(p)
+                    newly_dead.append(p)
+            if os.path.exists(self._tomb_path(self.rank)):
+                self.fenced = True
+            dead = sorted(self._dead)
+        alive_g = REGISTRY.gauge(
+            "worker_alive", "Membership liveness by base rank "
+            "(1 alive, 0 dead)")
+        for p in self.peers:
+            alive_g.labels(rank=p).set(0.0 if p in dead else 1.0)
+        for p in newly_dead:
+            REGISTRY.counter(
+                "membership_changes_total",
+                "Membership transitions (worker joins and losses)").inc()
+            trace.instant("worker_lost", rank=p,
+                          generation=self.generation)
+            from ..utils import console_logger
+
+            console_logger.warning(
+                f"membership: rank {p} declared dead (generation "
+                f"{self.generation}, heartbeat silence > {deadline:g}s)")
+        return dead
+
+    def dead_ranks(self) -> List[int]:
+        with self._lock:
+            return sorted(self._dead)
+
+    def alive_ranks(self) -> List[int]:
+        dead = set(self.dead_ranks())
+        return [p for p in self.peers if p not in dead]
+
+    def declare_dead(self, rank: int) -> None:
+        """Durable tombstone: later generations (and the fenced worker
+        itself, should it still be alive) read membership from these
+        instead of re-timing-out."""
+        from ..observability import trace
+
+        path = self._tomb_path(rank)
+        if not os.path.exists(path):
+            from ..resilience.checkpoint import atomic_write_bytes
+
+            try:
+                atomic_write_bytes(path, json.dumps(
+                    {"rank": rank, "by": self.rank,
+                     "generation": self.generation}).encode())
+            except OSError:
+                pass
+            trace.instant("worker_tombstoned", rank=rank, by=self.rank)
+        with self._lock:
+            if rank != self.rank:
+                self._dead.add(rank)
+
+    def wait_dead(self, ranks: List[int], timeout: float) -> List[int]:
+        """Block (scanning) until every rank in ``ranks`` is declared
+        dead or ``timeout`` elapses; returns the confirmed-dead subset.
+        Used to corroborate a collective failure before resizing — a
+        transient network fault must not shrink the world."""
+        t0 = time.monotonic()
+        want = set(ranks)
+        while True:
+            dead = set(self.scan())
+            if want <= dead or time.monotonic() - t0 > timeout:
+                return sorted(want & dead)
+            time.sleep(min(0.1, hb_interval() / 2))
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+    def start(self) -> "Membership":
+        """Spawn the beat agent, wait (briefly) for its first beat to
+        land — peers must be able to see this worker before it enters any
+        collective — then scan peers on a daemon monitor thread."""
+        self._grace_until = time.monotonic()
+        self._agent = self._spawn_agent()
+        t0 = time.monotonic()
+        while not os.path.exists(self._hb_path(self.rank)) \
+                and time.monotonic() - t0 < hb_deadline():
+            time.sleep(0.02)
+        self._stop.clear()
+
+        def loop() -> None:
+            while not self._stop.wait(hb_interval()):
+                self.scan()
+
+        self._thread = threading.Thread(
+            target=loop, name=f"xgbtpu-monitor-r{self.rank}", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2 * hb_interval())
+            self._thread = None
+        if self._agent is not None:
+            try:
+                self._agent.terminate()
+                self._agent.wait(timeout=5)
+            except Exception:
+                pass
+            self._agent = None

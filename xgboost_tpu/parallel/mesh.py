@@ -65,11 +65,74 @@ def mesh_context(mesh: Optional[Mesh]) -> Iterator[None]:
         _state.mesh = prev
 
 
+def _config_cpu_gloo() -> None:
+    """CPU backends need an explicit cross-process collectives
+    implementation on this jax (0.4.37 defaults to "none", which makes
+    EVERY multi-process computation fail with "Multiprocess computations
+    aren't implemented on the CPU backend"): pick gloo when the option
+    exists and is unset. TPU runtimes ignore it."""
+    import os as _os
+
+    if ("cpu" in (_os.environ.get("JAX_PLATFORMS") or "")
+            and not _os.environ.get("JAX_CPU_COLLECTIVES_IMPLEMENTATION")):
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:
+            pass  # other jax versions: sensible default, no such knob
+
+
+def form_world(coordinator_address: str, num_processes: int,
+               process_id: int) -> Mesh:
+    """Elastic-grade world formation: ``jax.distributed.initialize``
+    semantics with a runtime that SURVIVES peer death instead of
+    propagating it.
+
+    The stock coordination service health-checks members and, on a missed
+    heartbeat, broadcasts a fatal error that LOG(FATAL)s every surviving
+    process (xla client.h) — the exact opposite of elasticity. Here the
+    service is made deaf (effectively-infinite ``max_missing_heartbeats``;
+    liveness is owned by ``parallel.membership``'s file heartbeats) and
+    the client skips the shutdown barrier on destruction (a survivor must
+    exit cleanly after its peers are gone). Known asymmetry, documented
+    in docs/distributed.md: the COORDINATOR process (rank 0 of the
+    initial world) hosts the service in-process, so its death still takes
+    the runtime down — survivors of a coordinator loss recover by process
+    restart + checkpoint resume, not in-process resize (the rabit
+    tracker has the same single point of authority)."""
+    from jax._src import distributed as _dist
+    from jax._src.lib import xla_extension
+
+    _config_cpu_gloo()
+    st = _dist.global_state
+    if st.client is not None:
+        raise RuntimeError(
+            "form_world: jax distributed runtime already initialized in "
+            "this process; elastic re-formation at world > 1 requires a "
+            "process restart (docs/distributed.md, Elastic training)")
+    with _wd.watchdog("collective_init",
+                      seconds=_wd.deadline_for("collective_init", 900.0)):
+        if process_id == 0:
+            st.service = xla_extension.get_distributed_runtime_service(
+                "[::]:" + coordinator_address.rsplit(":", 1)[1],
+                num_processes, heartbeat_interval=10,
+                max_missing_heartbeats=1_000_000)
+        client = xla_extension.get_distributed_runtime_client(
+            coordinator_address, process_id, init_timeout=300,
+            shutdown_on_destruction=False, use_compression=True)
+        client.connect()
+    st.client = client
+    st.process_id = process_id
+    st.num_processes = num_processes
+    st.coordinator_address = coordinator_address
+    return make_mesh(devices=jax.devices())
+
+
 def init_distributed(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
     local_device_ids: Optional[Sequence[int]] = None,
+    elastic: bool = False,
 ) -> Mesh:
     """Multi-host entry point — the role the reference's dask frontend plays
     (``python-package/xgboost/dask.py:838-952``: start RabitTracker, hand
@@ -83,24 +146,16 @@ def init_distributed(
     row shard (the ``load_row_split`` analog — see
     ``docs/distributed.md``). Arguments mirror
     ``jax.distributed.initialize`` and may be omitted when the runtime
-    auto-detects (TPU pods). Returns the global mesh.
+    auto-detects (TPU pods). ``elastic=True`` routes through
+    :func:`form_world` — a peer-death-tolerant runtime whose liveness is
+    owned by ``parallel.membership`` instead of the coordination
+    service's fail-everything health check. Returns the global mesh.
     """
     if num_processes is not None and num_processes > 1:
-        # CPU backends need an explicit cross-process collectives
-        # implementation on this jax (0.4.37 defaults to "none", which
-        # makes EVERY multi-process computation fail with "Multiprocess
-        # computations aren't implemented on the CPU backend"): pick gloo
-        # when the option exists and is unset. TPU runtimes ignore it.
-        import os as _os
-
-        if ("cpu" in (_os.environ.get("JAX_PLATFORMS") or "")
-                and not _os.environ.get(
-                    "JAX_CPU_COLLECTIVES_IMPLEMENTATION")):
-            try:
-                jax.config.update(
-                    "jax_cpu_collectives_implementation", "gloo")
-            except Exception:
-                pass  # other jax versions: sensible default, no such knob
+        if elastic:
+            return form_world(coordinator_address, num_processes,
+                              process_id)
+        _config_cpu_gloo()
         # Deadline around the rendezvous: a wedged coordinator/relay here
         # is the mid-claim failure mode that burned bench round 5 —
         # better a clean WatchdogTimeout than a 10-hour hang. Default
@@ -131,13 +186,10 @@ def global_pad_rows(n_local: int, unit: int) -> int:
     inert, so processes just agree on the largest block here."""
     n_pad = pad_to_multiple(max(n_local, 1), unit)
     if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
+        from .. import collective
 
-        from ..observability import comms
-
-        sizes = np.asarray(multihost_utils.process_allgather(
-            np.asarray(n_pad, np.int64)))
-        comms.record("process_allgather", 8)
+        sizes = collective.process_allgather(
+            np.asarray(n_pad, np.int64), site="pad_rows")
         n_pad = int(sizes.max())
     return n_pad
 
@@ -163,13 +215,10 @@ def _check_equal_blocks(n_local: int) -> None:
     """Multi-process row sharding requires every process to contribute the
     SAME padded block size (global shape inference and the per-shard
     validity mask both assume it). Fails loudly instead of deadlocking."""
-    from jax.experimental import multihost_utils
+    from .. import collective
 
-    from ..observability import comms
-
-    sizes = np.asarray(multihost_utils.process_allgather(
-        np.asarray(n_local, np.int64)))
-    comms.record("process_allgather", 8)
+    sizes = collective.process_allgather(
+        np.asarray(n_local, np.int64), site="equal_blocks")
     if not (sizes == sizes[0]).all():
         raise ValueError(
             "multi-process training requires equal PADDED row blocks per "

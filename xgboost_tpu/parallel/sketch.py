@@ -20,6 +20,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..collective import psum as _coll_psum
 from ..data.quantile import HistogramCuts
 from .mesh import ROW_AXIS
 
@@ -113,8 +114,8 @@ def distributed_compute_cuts(
         r = jax.lax.axis_index(ROW_AXIS)
 
         def bcast0(a):
-            return jax.lax.psum(jnp.where(r == 0, a, jnp.zeros_like(a)),
-                                ROW_AXIS)
+            return _coll_psum(jnp.where(r == 0, a, jnp.zeros_like(a)),
+                              ROW_AXIS)
 
         return bcast0(cuts), bcast0(mins)
 

@@ -19,6 +19,17 @@ site                injection point
 ``checkpoint_write``  atomic checkpoint writes (``resilience/checkpoint``)
 ``gradient``/``grow``/``eval``  the per-round host dispatch boundaries
                     (``utils/fault.py`` sites, bridged here)
+``worker_kill``     the elastic round guard (``training.py``): a fired
+                    hit SIGKILLs the worker mid-round — the rabit-mock
+                    "kill at (version, seqno)" analog driving the elastic
+                    resize tests deterministically
+``heartbeat_drop``  the membership heartbeat writer
+                    (``parallel/membership.py``): a fired hit skips that
+                    beat, exercising loss detection and false-positive
+                    tolerance without killing anything
+``collective_timeout``  every guarded host-side collective
+                    (``collective.guarded``): a fired hit presents as a
+                    transient deadline expiry at that exact site
 ==================  =====================================================
 
 Configuration — ``XGBTPU_CHAOS="site:kind:schedule[;site:kind:schedule]"``
@@ -59,7 +70,8 @@ _ENV = "XGBTPU_CHAOS"
 #: the documented injection sites (informational — arbitrary names work,
 #: e.g. synthetic sites in tests)
 SITES = ("compile", "pallas", "collective", "pager_io", "native_load",
-         "checkpoint_write", "gradient", "grow", "eval")
+         "checkpoint_write", "gradient", "grow", "eval",
+         "worker_kill", "heartbeat_drop", "collective_timeout")
 
 
 class ChaosError(RuntimeError):

@@ -39,7 +39,8 @@ from . import chaos, policy
 
 __all__ = [
     "FORMAT", "checkpoint_path", "save_checkpoint", "read_checkpoint",
-    "load_latest", "list_checkpoints", "process_dir",
+    "load_latest", "list_checkpoints", "process_dir", "inspect_dir",
+    "verify_checkpoint", "atomic_write_bytes",
 ]
 
 FORMAT = "xgbtpu-ckpt-v1"
@@ -50,15 +51,21 @@ def checkpoint_path(directory: str, rounds: int) -> str:
     return os.path.join(directory, f"ckpt_{rounds:08d}.ckpt")
 
 
-def process_dir(directory: str) -> str:
+def process_dir(directory: str, shared: bool = False) -> str:
     """The per-process checkpoint directory (created if missing). Multi-
     process runs get a ``rank<r>`` subdirectory each: models are
     replicated bit-identically across ranks, so every rank owning its own
-    files avoids cross-process rename races without any coordination."""
+    files avoids cross-process rename races without any coordination.
+
+    ``shared=True`` (the elastic layer) keeps ONE directory for every
+    rank: payloads are bit-identical across ranks and the atomic writer
+    uses pid-unique tmp names, so concurrent writers of the same round
+    are idempotent — and the checkpoint survives ANY subset of workers
+    dying, which per-rank directories cannot guarantee a reader for."""
     import jax
 
     try:
-        if jax.process_count() > 1:
+        if not shared and jax.process_count() > 1:
             directory = os.path.join(directory,
                                      f"rank{jax.process_index()}")
     except Exception:
@@ -67,13 +74,15 @@ def process_dir(directory: str) -> str:
     return directory
 
 
-def _write_atomic(path: str, header: bytes, payload: bytes) -> None:
-    chaos.hit("checkpoint_write")
-    tmp = path + ".tmp"
+def atomic_write_bytes(path: str, data: bytes) -> None:
+    """Durable atomic file write: pid-unique tmp + fsync + ``os.replace``
+    + directory fsync. The ONE implementation behind checkpoints, the
+    elastic generation file and membership tombstones — pid-unique tmp
+    names mean concurrent ranks writing identical payloads into a shared
+    directory commute instead of interleaving one tmp file."""
+    tmp = f"{path}.tmp.{os.getpid()}"
     with open(tmp, "wb") as f:
-        f.write(header)
-        f.write(b"\n")
-        f.write(payload)
+        f.write(data)
         f.flush()
         os.fsync(f.fileno())
     os.replace(tmp, path)
@@ -87,6 +96,11 @@ def _write_atomic(path: str, header: bytes, payload: bytes) -> None:
             os.close(dfd)
     except OSError:
         pass
+
+
+def _write_atomic(path: str, header: bytes, payload: bytes) -> None:
+    chaos.hit("checkpoint_write")
+    atomic_write_bytes(path, header + b"\n" + payload)
 
 
 def save_checkpoint(directory: str, booster, rounds: int, *,
@@ -181,3 +195,66 @@ def load_latest(directory: str) -> Optional[Tuple[bytes, int]]:
         if got is not None:
             return got
     return None
+
+
+def verify_checkpoint(path: str) -> Tuple[bool, str, int]:
+    """(verified, detail, rounds) for one checkpoint file, without
+    loading the payload into anything: the read-side verification of
+    ``read_checkpoint`` with the reason surfaced instead of logged."""
+    try:
+        with open(path, "rb") as f:
+            header_line = f.readline(1 << 16)
+            payload = f.read()
+    except OSError as e:
+        return False, f"unreadable ({e})", -1
+    try:
+        header = json.loads(header_line)
+    except ValueError:
+        return False, "unparsable header", -1
+    rounds = int(header.get("rounds", -1))
+    if header.get("format") != FORMAT:
+        return False, f"unknown format {header.get('format')!r}", rounds
+    if len(payload) != header.get("payload_bytes"):
+        return False, (f"truncated: {len(payload)} of "
+                       f"{header.get('payload_bytes')} payload bytes"), rounds
+    if hashlib.sha256(payload).hexdigest() != header.get("sha256"):
+        return False, "checksum mismatch (bit corruption)", rounds
+    return True, "ok", rounds
+
+
+def inspect_dir(directory: str) -> List[dict]:
+    """Operator-facing inventory of ``directory`` (including per-rank
+    subdirectories from non-shared multi-process runs): one record per
+    checkpoint file with round, size, checksum-verify status, and
+    ``newest_verified`` marking the snapshot ``load_latest`` would resume
+    from — the read side of ``train(resume_from=...)``. Used by
+    ``python -m xgboost_tpu checkpoint-inspect``."""
+    dirs = [directory]
+    try:
+        for name in sorted(os.listdir(directory)):
+            sub = os.path.join(directory, name)
+            if name.startswith("rank") and os.path.isdir(sub):
+                dirs.append(sub)
+    except OSError:
+        return []
+    records: List[dict] = []
+    for d in dirs:
+        best = None  # newest verified within this resume scope
+        recs = []
+        for path in list_checkpoints(d):
+            ok, detail, rounds = verify_checkpoint(path)
+            rec = {
+                "path": path,
+                "rounds": rounds,
+                "bytes": os.path.getsize(path),
+                "verified": ok,
+                "detail": detail,
+                "newest_verified": False,
+            }
+            recs.append(rec)
+            if ok:
+                best = rec
+        if best is not None:
+            best["newest_verified"] = True
+        records.extend(recs)
+    return records

@@ -36,6 +36,7 @@ from typing import Callable, Optional, Sequence, Tuple
 __all__ = [
     "TRANSIENT", "RESOURCE", "PERMANENT", "KINDS",
     "classify", "record_failure", "retry_budget", "RetryPolicy",
+    "is_worker_loss",
 ]
 
 TRANSIENT = "transient"
@@ -57,6 +58,33 @@ _RESOURCE_SUBSTRINGS = (
     "resource_exhausted", "resource exhausted", "out of memory", "oom",
     "bytes_limit", "failed to allocate", "allocation failure",
 )
+
+
+# peer-death failure signatures: a collective that broke because the far
+# end went away (gloo ring break, TCP reset, coordination-service loss).
+# Distinct from plain TRANSIENT: retrying in place is futile AND unsafe
+# (a one-sided retry desyncs SPMD lockstep) — the elastic layer responds
+# by resizing the world instead (docs/distributed.md, Elastic training).
+_WORKER_LOSS_SUBSTRINGS = (
+    "connection closed by peer", "connection reset", "connection refused",
+    "broken pipe", "socket closed", "peer closed",
+    # specific gloo op failures only — a bare "gloo" would classify
+    # setup/config errors ("gloo transport is not available") as deaths
+    "gloo all-reduce failed", "gloo allgather failed",
+    "gloo all-gather failed", "gloo broadcast failed", "gloo reduce failed",
+    "heartbeat timeout", "task has failed", "worker_lost",
+)
+
+
+def is_worker_loss(exc: BaseException) -> bool:
+    """Whether ``exc``'s signature reads as a dead communication peer.
+    Chaos faults injected at the ``worker_kill`` / ``heartbeat_drop``
+    sites count as peer loss (they script exactly that failure)."""
+    site = getattr(exc, "site", None)
+    if site in ("worker_kill", "heartbeat_drop"):
+        return True
+    msg = str(exc).lower()
+    return any(t in msg for t in _WORKER_LOSS_SUBSTRINGS)
 
 
 def classify(exc: BaseException) -> str:

@@ -49,6 +49,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..collective import psum as _coll_psum
 from .param import RT_EPS, SplitParams, calc_gain, calc_gain_given_weight, calc_weight
 
 __all__ = [
@@ -283,8 +284,9 @@ def blocked_histogram(
     else:
         _, hs = jax.lax.scan(lambda c, i: (c, block(i)), None, jnp.arange(nb))
         hist = jnp.transpose(hs, (1, 0, 2, 3, 4)).reshape(K, Fp, MB, 2)[:, :F]
-    if axis_name is not None:
-        hist = jax.lax.psum(hist, axis_name=axis_name)
+    # the hist/histogram.h:201 AllReduce, via the collective layer's
+    # traced helper (identity when axis_name is None)
+    hist = _coll_psum(hist, axis_name)
     return hist
 
 
@@ -683,9 +685,8 @@ def _grow_tree_impl(
         state = init
         # single leaf: weight from global sums
         G, H = grad.sum(), hess.sum()
-        if cfg.axis_name is not None:
-            G = jax.lax.psum(G, cfg.axis_name)
-            H = jax.lax.psum(H, cfg.axis_name)
+        G = _coll_psum(G, cfg.axis_name)
+        H = _coll_psum(H, cfg.axis_name)
         state = (
             state[0], state[1], state[2], state[3], state[4], state[5],
             state[6].at[0].set(G), state[7].at[0].set(H),

@@ -459,8 +459,9 @@ def grow_tree_fused_paged(
     assert cfg.axis_name is None, (
         "paged + mesh is not supported inside one process; compose them "
         "ACROSS processes instead — shard rows across processes (dsplit="
-        "row), page within each. Recipe: docs/serving.md, 'Composing "
-        "external memory with a mesh'.")
+        "row), page within each, elastically if workers may die. Recipe: "
+        "docs/distributed.md, 'Composing external memory with a mesh "
+        "(paged + sharded rows)'.")
     assert not cfg.has_categorical
     from ..observability import trace as _trace
 

@@ -111,13 +111,10 @@ def hoist_plan_synced(n_pad: int, F: int, B: int, max_depth: int = 6) -> int:
     if jax.process_count() > 1:
         import numpy as _np
 
-        from jax.experimental import multihost_utils
+        from .. import collective
 
-        from ..observability import comms
-
-        all_fh = _np.asarray(multihost_utils.process_allgather(
-            _np.asarray(fh, _np.int64)))
-        comms.record("process_allgather", 8)
+        all_fh = collective.process_allgather(
+            _np.asarray(fh, _np.int64), site="hoist_plan")
         fh = int(all_fh.min())
     return fh
 
