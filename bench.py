@@ -462,6 +462,65 @@ def _predict_bench(xgb, X, y, args, suffix: str, final_predict: dict) -> None:
                                  for k, v in latency.items()}})
 
 
+def _report_arithmetic_intensity() -> None:
+    """FLOPs / bytes-accessed of the guarded programs compiled so far
+    (exported by the cost-analysis probe around the smoke run): the
+    number that says whether a kernel is compute- or bandwidth-bound —
+    the context every histogram-packing / fusion PR (ROADMAP 3) needs
+    next to its timing delta."""
+    try:
+        from xgboost_tpu.observability import REGISTRY
+
+        flops_fam = REGISTRY.get("xla_cost_flops")
+        bytes_fam = REGISTRY.get("xla_cost_bytes_accessed")
+        if flops_fam is None or bytes_fam is None:
+            return
+        by_fn = {}
+        for labels, child in flops_fam.series():
+            by_fn.setdefault(labels.get("fn", "?"), [0.0, 0.0])[0] = \
+                child.value
+        for labels, child in bytes_fam.series():
+            by_fn.setdefault(labels.get("fn", "?"), [0.0, 0.0])[1] = \
+                child.value
+        rec = {"config": "cost_analysis"}
+        for fn, (fl, by) in sorted(by_fn.items()):
+            if fl <= 0 and by <= 0:
+                continue
+            ai = fl / by if by > 0 else 0.0
+            print(f"# cost[{fn}]: {fl:.3e} flops, {by:.3e} bytes, "
+                  f"arithmetic intensity {ai:.2f} flop/B",
+                  file=sys.stderr, flush=True)
+            rec[fn] = {"flops": fl, "bytes": by,
+                       "intensity": round(ai, 3)}
+        if len(rec) > 1:
+            _log_partial(rec)
+    except Exception as e:  # telemetry must never dent the bench
+        print(f"# cost-analysis report skipped: {e}", file=sys.stderr,
+              flush=True)
+
+
+def _report_stage_breakdown(stages0: dict, label: str) -> None:
+    """Per-stage wall-clock deltas (sketch/grow/eval/checkpoint) from the
+    flight recorder since ``stages0`` — where the measured loop's time
+    went, by phase (ISSUE 7 satellite)."""
+    try:
+        from xgboost_tpu.observability import flight
+
+        now = flight.stage_totals()
+        delta = {k: round(now.get(k, 0.0) - stages0.get(k, 0.0), 3)
+                 for k in sorted(set(now) | set(stages0))}
+        delta = {k: v for k, v in delta.items() if v > 0}
+        if not delta:
+            return
+        print(f"# stage breakdown [{label}]: "
+              + " ".join(f"{k}={v:.2f}s" for k, v in delta.items()),
+              file=sys.stderr, flush=True)
+        _log_partial({"config": f"stages_{label}", "stage_seconds": delta})
+    except Exception as e:
+        print(f"# stage breakdown skipped: {e}", file=sys.stderr,
+              flush=True)
+
+
 def _run_configs(args, suffix: str, final: dict) -> None:
     """The measurement body. Mutates ``final`` (the record the caller's
     ``finally`` prints) after every completed stage so a crash at ANY later
@@ -524,14 +583,25 @@ def _run_configs(args, suffix: str, final: dict) -> None:
         })
 
     # ---- smoke: whole pipeline on a tiny shape; failures surface fast ----
+    # The smoke run doubles as the XLA cost-analysis probe (ISSUE 7): with
+    # XGBTPU_COST_ANALYSIS armed, every guarded program compiled here
+    # exports its FLOPs/bytes so the arithmetic-intensity lines below come
+    # for free; the flag is dropped afterwards so the measured loops never
+    # pay the bookkeeping AOT compiles.
     t0 = time.perf_counter()
+    cost_armed = os.environ.get("XGBTPU_COST_ANALYSIS") is None
+    if cost_armed:
+        os.environ["XGBTPU_COST_ANALYSIS"] = "1"
     smoke_rows = min(args.smoke_rows, args.rows)
     Xs, ys = _make_data(smoke_rows, args.columns, args.sparsity, seed=7)
     sd, ss, sauc = _train_measured(xgb, Xs, ys, params_for(args.max_bin),
                                    rounds=3, budget_s=1e9, chunk=3)
+    if cost_armed:
+        os.environ.pop("XGBTPU_COST_ANALYSIS", None)
     print(f"# smoke {smoke_rows}x{args.columns} 3r: {ss:.2f}s auc={sauc:.3f} "
           f"(total incl. compile {time.perf_counter() - t0:.1f}s)",
           file=sys.stderr, flush=True)
+    _report_arithmetic_intensity()
     if sauc != sauc:
         raise SystemExit("smoke predict failed — predictor is broken")
 
@@ -571,6 +641,9 @@ def _run_configs(args, suffix: str, final: dict) -> None:
         len(hoist_ladder)
     env_retries = res_policy.retry_budget("bench_train")
     transient_left = 1 if env_retries is None else max(0, env_retries)
+    from xgboost_tpu.observability import flight as _flight
+
+    stages0 = _flight.stage_totals()
     while True:
         try:
             X, y = _make_data(rows, args.columns, args.sparsity)
@@ -606,6 +679,7 @@ def _run_configs(args, suffix: str, final: dict) -> None:
     rps = done / measured if measured > 0 else 0.0
     print(f"# [max_bin={primary_bin}] rounds/s: {rps:.2f}  test-auc: {auc:.4f}",
           file=sys.stderr, flush=True)
+    _report_stage_breakdown(stages0, f"bin{primary_bin}")
     _log_partial({"config": f"bin{primary_bin}", "rows": rows,
                   "rounds_done": done, "seconds": round(measured, 3),
                   "auc": None if auc != auc else round(auc, 5),

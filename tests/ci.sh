@@ -146,12 +146,15 @@ print(f"chaos smoke OK: {len(plan.fired)} injected faults absorbed, "
       "fault history in exposition")
 EOF
 
-echo "=== tier 1.6: elastic chaos lane (seeded worker_kill) ==="
+echo "=== tier 1.6: elastic chaos lane (seeded worker_kill + obs-report) ==="
 # A 2-process gloo training run with XGBTPU_CHAOS="worker_kill:..." armed
 # on rank 1: the scripted SIGKILL mid-round must drive the full elastic
 # path — heartbeat detection -> quiesce at the round boundary -> resize
 # 2 -> 1 -> checkpoint replay to completion — and the elastic metrics
-# must land in the survivor's exposition (docs/distributed.md).
+# must land in the survivor's exposition (docs/distributed.md). Then
+# `obs-report` must merge both ranks' flight-recorder sinks into one
+# clock-aligned trace with the membership instants and an elastic
+# metrics rollup (ISSUE 7; docs/observability.md).
 python - <<'EOF'
 import json, os, signal, socket, subprocess, sys, tempfile
 
@@ -183,6 +186,41 @@ for needle in ("membership_changes_total 1", "worker_restarts_total 1",
     assert needle in prom, f"missing {needle!r} in elastic exposition"
 print("elastic chaos lane OK: detection -> quiesce -> resize -> replay, "
       "metrics exported")
+
+# obs-report on the same run_dir (ISSUE 7): both ranks' flight-recorder
+# sinks must merge into one clock-aligned trace with the membership
+# instants visible, and the metrics rollup must carry the elastic
+# counters (the SIGKILLed rank contributes whatever it flushed)
+from xgboost_tpu.cli import cli_main
+from xgboost_tpu.observability import load_trace
+
+rc = cli_main(["obs-report", outdir])
+assert rc == 0, f"obs-report failed (rc={rc})"
+merged = load_trace(os.path.join(outdir, "obs", "merged.trace.json"))
+assert merged, "obs-report produced an empty merged trace"
+pids = {e.get("pid") for e in merged if e.get("ph") == "X"}
+assert 0 in pids, f"rank 0's spans missing from merged trace: {pids}"
+names = {e.get("name") for e in merged if e.get("ph") == "i"}
+assert names & {"worker_lost", "worker_tombstoned"}, \
+    f"membership instants missing from merged trace: {sorted(names)}"
+assert "elastic_quiesce" in names and "elastic_resize" in names, names
+roll = json.load(open(os.path.join(outdir, "obs", "metrics_rollup.json")))
+assert "worker_restarts_total" in roll["rollup"], sorted(roll["rollup"])
+assert roll["rollup"]["worker_restarts_total"]["series"][0]["value"] >= 1
+# the SIGKILLed rank's black-box contract: every line it committed
+# before the kill still parses (the in-flight round may be torn)
+r1 = os.path.join(outdir, "obs", "rank1", "flight.jsonl")
+lines = [ln for ln in open(r1).read().splitlines() if ln.strip()]
+parsed = []
+for i, ln in enumerate(lines):
+    try:
+        parsed.append(json.loads(ln))
+    except ValueError:
+        assert i == len(lines) - 1, f"torn non-final line {i} in {r1}"
+assert any(rec.get("t") == "round" for rec in parsed), \
+    "SIGKILLed rank committed no round records before dying"
+print(f"obs-report OK: {len(merged)} merged events, ranks {sorted(pids)}, "
+      "membership instants + elastic rollup + SIGKILL black box present")
 EOF
 
 echo "=== tier 2: trace parses as Chrome trace JSON ==="

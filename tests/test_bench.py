@@ -29,9 +29,18 @@ def test_bench_produces_json_lines():
     env.pop("XGBTPU_BENCH_DEADLINE_AT", None)  # in-process tests may set it
     env["JAX_PLATFORMS"] = "cpu"
     env["XGBTPU_BENCH_PREDICT_BUDGET"] = "1.0"  # contract, not measurement
+    # contract test, not a measurement: skip the smoke run's AOT
+    # cost-analysis compiles (tier-1 time budget; tests/test_flight.py
+    # covers the export itself)
+    env["XGBTPU_COST_ANALYSIS"] = "0"
+    # contract-sized workload (was 20k x 8r: ~75s of 1-core tier-1
+    # budget). 12k rows is the floor where the native walker's >= 3x
+    # serving bar still holds (measured 3.4x at 12k vs 2.7x at 6k —
+    # the DMatrix path's fixed per-request cost shrinks the ratio at
+    # small batches); every other asserted behavior is size-independent.
     out = subprocess.run(
-        [sys.executable, "bench.py", "--rows", "20000", "--iterations", "8",
-         "--smoke_rows", "4000", "--budget", "120", "--chunk", "4",
+        [sys.executable, "bench.py", "--rows", "12000", "--iterations", "4",
+         "--smoke_rows", "1500", "--budget", "120", "--chunk", "2",
          "--tuned_max_bin", "32"],
         cwd=REPO, env=env, capture_output=True, text=True, timeout=900,
     )
@@ -42,13 +51,13 @@ def test_bench_produces_json_lines():
     rec = json.loads(lines[0])
     assert set(rec) == {"metric", "value", "unit", "vs_baseline"}
     assert rec["unit"] == "s" and rec["value"] > 0
-    assert rec["metric"].startswith("train_time_20kx50_8r_depth6")
-    # off-baseline workload (20k != 1M rows): ratio must not pose as speedup
+    assert rec["metric"].startswith("train_time_12kx50_4r_depth6")
+    # off-baseline workload (12k != 1M rows): ratio must not pose as speedup
     assert rec["vs_baseline"] == 0.0
     pred = json.loads(lines[1])
     assert set(pred) == {"metric", "value", "unit", "vs_baseline"}
     assert pred["unit"] == "rows/s" and pred["value"] > 0
-    assert pred["metric"].startswith("predict_inplace_20kx50")
+    assert pred["metric"].startswith("predict_inplace_12kx50")
     assert "parity_failed" not in pred["metric"]
     assert pred["vs_baseline"] > 0
     # the acceptance bar (>= 3x over the per-request DMatrix path) holds
@@ -210,6 +219,7 @@ def test_bench_watchdog_emits_on_midrun_hang():
     env["JAX_PLATFORMS"] = "cpu"
     env["XGBTPU_BENCH_TEST_HANG"] = "after_chunk"
     env["XGBTPU_BENCH_DEADLINE"] = "150"
+    env["XGBTPU_COST_ANALYSIS"] = "0"  # contract test: skip AOT cost pass
     out = subprocess.run(
         [sys.executable, "bench.py", "--rows", "4000", "--columns", "8",
          "--iterations", "6", "--smoke_rows", "2000", "--budget", "120",
@@ -227,6 +237,8 @@ def test_bench_watchdog_emits_on_midrun_hang():
     assert "watchdog: deadline reached" in out.stderr
 
 
+@pytest.mark.slow  # ~30s of tier-1 budget (1-core box); the
+# after_chunk hang + watchdog-emit contract above stays in tier-1
 def test_bench_hanging_jax_still_emits(tmp_path):
     """The full round-4 scenario end-to-end: jax is importable but every
     backend touch hangs forever (wedged relay). The probe must expire, the

@@ -667,6 +667,8 @@ def test_feature_names_from_any_cache_and_fmap(tmp_path):
     assert h.shape[1] == 2
 
 
+@pytest.mark.slow  # ~12s of tier-1 budget (1-core box); the main
+# scan-vs-per-round parity pin above stays in tier-1
 def test_update_many_scan_with_num_parallel_tree():
     """The whole-chunk scan now handles num_parallel_tree > 1 (boosted
     random forests): predictions must match per-round updates exactly and

@@ -13,6 +13,7 @@ import subprocess
 import sys
 
 import numpy as np
+import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -190,6 +191,10 @@ def _run_pair(worker, port, ckdir, outdir, env):
     return [(p.returncode, o) for p, o in zip(procs, outs)]
 
 
+@pytest.mark.slow  # ~29s of tier-1 budget (1-core box); tier-1 keeps
+# the single-process SIGKILL-resume pin AND the 2-proc elastic
+# worker_kill recovery test (test_elastic.py), which exercises this
+# same 2-process kill->resume path end to end
 def test_sigkill_resume_equivalence_two_process(tmp_path):
     """Acceptance criterion: SIGKILL a 2-process distributed run
     mid-round, resume both ranks from their atomic checkpoints (per-rank

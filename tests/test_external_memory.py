@@ -93,6 +93,7 @@ def test_native_pagecache_builds():
     assert lib is not None, "native page cache failed to build"
 
 
+@pytest.mark.slow  # ~18s of tier-1 budget (1-core box); run with -m slow
 def test_paged_training_equals_streaming_at_scale():
     """The paging machinery must be EXACT relative to the same streaming
     sketch: an external-memory matrix and a StreamingQuantileDMatrix built

@@ -1,0 +1,489 @@
+"""Fleet flight recorder (ISSUE 7): per-round records, black-box dumps,
+cross-rank obs-report aggregation, histogram quantiles, profiling hooks —
+plus the rounds/s decay pin and the ≤2% overhead pin."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import xgboost_tpu as xgb
+from xgboost_tpu.callback import FlightRecorderMonitor
+from xgboost_tpu.observability import RECORDER, REGISTRY, flight, trace
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+@pytest.fixture(autouse=True)
+def _clean_flight(monkeypatch):
+    """Fresh recorder + trace state per test: the recorder is process-wide
+    and always on, so tests must not see each other's rings or sinks."""
+    for var in ("XGBTPU_TRACE", "XGBTPU_FLIGHT", "XGBTPU_PROFILE",
+                "XGBTPU_PROFILE_ROUNDS", "XGBTPU_COST_ANALYSIS"):
+        monkeypatch.delenv(var, raising=False)
+    RECORDER.reset()
+    trace.reset()
+    yield
+    RECORDER.reset()
+    flight.profile_reset()
+    trace.reset()
+
+
+def _data(n=600, F=6, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, F).astype(np.float32)
+    y = ((X @ rng.randn(F)) > 0).astype(np.float32)
+    return X, y
+
+
+_PARAMS = {"max_depth": 3, "max_bin": 16, "verbosity": 0}
+
+
+# ---------------------------------------------------------------- recorder
+
+def test_round_records_from_training(tmp_path):
+    X, y = _data()
+    d = xgb.DMatrix(X, label=y)
+    dv = xgb.DMatrix(X[:100], label=y[:100])
+    p = dict(_PARAMS, eval_metric="logloss")
+    xgb.train(p, d, 4, evals=[(dv, "val")], verbose_eval=False,
+              resume_from=str(tmp_path))
+    recs = [r for r in RECORDER.records() if r.get("t") == "round"]
+    assert len(recs) == 4
+    for i, r in enumerate(recs):
+        assert r["round"] == i and r["rounds"] == 1
+        assert r["wall_s"] > 0
+        # the ISSUE 7 record fields: stage split, guard deltas, watermarks
+        assert {"grow", "eval", "checkpoint"} <= set(r["stages"])
+        assert r["stages"]["grow"] > 0
+        assert "retraces" in r and "coll_ops" in r and "coll_bytes" in r
+        assert r["rss_peak_mb"] > 0
+    # round 0 compiles: its retrace delta must be visible
+    assert recs[0]["retraces"] >= 1
+    assert RECORDER.last()["round"] == 3
+    json.dumps(recs)  # JSONL-able
+
+
+def test_update_many_chunk_records():
+    X, y = _data()
+    d = xgb.DMatrix(X, label=y)
+    bst = xgb.Booster(_PARAMS, [d])
+    RECORDER.reset()
+    bst.update_many(d, 0, 4, chunk=2)
+    recs = [r for r in RECORDER.records() if r.get("t") == "round"]
+    assert [(r["round"], r["rounds"]) for r in recs] == [(0, 2), (2, 2)]
+    assert all(r["stages"].get("grow", 0) > 0 for r in recs)
+
+
+def test_flight_callback_live_query():
+    X, y = _data()
+    d = xgb.DMatrix(X, label=y)
+    seen = []
+    mon = FlightRecorderMonitor(on_record=lambda r: seen.append(r["round"]))
+    xgb.train(_PARAMS, d, 3, verbose_eval=False, callbacks=[mon])
+    assert seen == [0, 1, 2]
+    assert mon.latest["round"] == 2
+    assert any(r.get("t") == "round" for r in mon.records())
+
+
+def test_nested_begin_is_not_owner_and_generation_stamps():
+    """The mesh per-round path routes update() through a 1-chunk
+    update_many: the nested begin must not own the record (its stage
+    notes would double-count the owner's), and records carry the elastic
+    generation set by elastic_train."""
+    RECORDER.set_generation(3)
+    assert RECORDER.begin_round(7) is True
+    assert RECORDER.begin_round(7, rounds=1) is False  # nested
+    RECORDER.end_round()  # nested end: record stays open
+    RECORDER.note("grow", 0.5)
+    rec = RECORDER.end_round()
+    assert rec is not None and rec["gen"] == 3
+    assert rec["stages"]["grow"] == 0.5  # counted exactly once
+    assert RECORDER.last()["round"] == 7
+
+
+def test_ring_is_bounded_and_disable_switch(monkeypatch):
+    cap = RECORDER._ring.maxlen
+    for i in range(cap + 7):
+        RECORDER.begin_round(i)
+        RECORDER.end_round()
+    assert len(RECORDER._ring) == cap
+    monkeypatch.setenv("XGBTPU_FLIGHT", "0")
+    RECORDER.reset()
+    RECORDER.begin_round(0)
+    assert RECORDER.end_round() is None
+    assert RECORDER.records() == []
+
+
+def test_sink_persists_jsonl_and_sidecars(tmp_path):
+    run = str(tmp_path / "run")
+    flight.configure(run, rank=0)
+    X, y = _data()
+    d = xgb.DMatrix(X, label=y)
+    xgb.train(_PARAMS, d, 3, verbose_eval=False)
+    rank_dir = os.path.join(run, "obs", "rank0")
+    lines = [json.loads(ln) for ln in
+             open(os.path.join(rank_dir, "flight.jsonl"))]
+    assert lines[0]["t"] == "meta" and lines[0]["rank"] == 0
+    assert "unix_ns" in lines[0]["clock"]
+    assert sum(1 for r in lines if r["t"] == "round") == 3
+    # sidecars: clock base, metrics snapshot, span trace (sink-enabled)
+    clock = json.load(open(os.path.join(rank_dir, "clock.json")))
+    assert clock["unix_ns"] > 0
+    metrics = json.load(open(os.path.join(rank_dir, "metrics.json")))
+    assert "rounds_total" in metrics
+    events = trace.load_trace(os.path.join(rank_dir, "trace.jsonl"))
+    assert any(e.get("name") == "round" for e in events)
+
+
+def test_abort_leaves_parseable_blackbox(tmp_path):
+    run = str(tmp_path / "run")
+    flight.configure(run, rank=0)
+    X, y = _data()
+    d = xgb.DMatrix(X, label=y)
+
+    class Bomb(xgb.callback.TrainingCallback):
+        def after_iteration(self, model, epoch, evals_log):
+            if epoch == 2:
+                raise RuntimeError("synthetic crash")
+            return False
+
+    with pytest.raises(RuntimeError, match="synthetic crash"):
+        xgb.train(_PARAMS, d, 6, verbose_eval=False, callbacks=[Bomb()])
+    bb = json.load(open(os.path.join(run, "obs", "rank0", "blackbox.json")))
+    assert bb["reason"] == "abort:RuntimeError"
+    rounds = [r for r in bb["records"] if r.get("t") == "round"]
+    assert len(rounds) >= 2  # completed rounds before the crash
+    assert any(r.get("t") == "event" and r["name"] == "train_abort"
+               for r in bb["records"])
+    assert "rounds_total" in bb["metrics"]
+
+
+def test_watchdog_expiry_dumps_blackbox(tmp_path):
+    from xgboost_tpu.resilience.watchdog import WatchdogTimeout, watchdog
+
+    run = str(tmp_path / "run")
+    flight.configure(run, rank=0)
+    with pytest.raises(WatchdogTimeout):
+        with watchdog("flight_test_site", seconds=0.2):
+            # chunked: interrupt_main lands between bytecodes, so one
+            # long sleep would run to completion before aborting
+            for _ in range(200):
+                time.sleep(0.05)
+    bb = json.load(open(os.path.join(run, "obs", "rank0", "blackbox.json")))
+    assert bb["reason"] == "watchdog:flight_test_site"
+    assert any(r.get("t") == "event" and r["name"] == "watchdog_timeout"
+               for r in bb["records"])
+
+
+@pytest.mark.slow
+def test_sigkill_leaves_parseable_flight_jsonl(tmp_path):
+    """The acceptance black-box contract: a SIGKILL mid-run loses at most
+    the in-flight round — everything committed before it parses. Slow
+    (fresh interpreter): the same contract runs on every CI pass in the
+    tier-1.6 elastic lane, which SIGKILLs rank 1 and asserts its
+    flight.jsonl parses into obs-report's merge."""
+    run = str(tmp_path / "run")
+    code = f"""
+import os, signal
+import numpy as np
+import xgboost_tpu as xgb
+from xgboost_tpu.observability import flight
+
+flight.configure({run!r}, rank=0)
+rng = np.random.RandomState(0)
+X = rng.randn(600, 6).astype(np.float32)
+y = (X[:, 0] > 0).astype(np.float32)
+d = xgb.DMatrix(X, label=y)
+
+class Kill(xgb.callback.TrainingCallback):
+    def after_iteration(self, model, epoch, evals_log):
+        if epoch == 3:
+            os.kill(os.getpid(), signal.SIGKILL)
+        return False
+
+xgb.train({_PARAMS!r}, d, 50, verbose_eval=False, callbacks=[Kill()])
+"""
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    r = subprocess.run([sys.executable, "-c", code], env=env, cwd=REPO,
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == -signal.SIGKILL, r.stderr[-2000:]
+    path = os.path.join(run, "obs", "rank0", "flight.jsonl")
+    recs = []
+    for ln in open(path).read().splitlines():
+        if ln.strip():
+            recs.append(json.loads(ln))  # every committed line parses
+    rounds = [r_ for r_ in recs if r_.get("t") == "round"]
+    assert len(rounds) == 3, [r_.get("round") for r_ in rounds]
+    # the kill fired inside round 3, before its end_round: not recorded
+    assert [r_["round"] for r_ in rounds] == [0, 1, 2]
+
+
+# ---------------------------------------------------------- perf pins
+
+def test_recorder_overhead_at_most_2pct_of_round():
+    """Acceptance: flight recording ≤ 2% of a small-bench round with
+    tracing disabled. Measured directly: the recorder's begin/note/end
+    cycle cost (best of 3 batches — robust to scheduler spikes on a
+    loaded CI core) vs the median measured round wall time. Reuses the
+    suite's standard shape so no extra compile is paid."""
+    assert not trace.enabled()
+    X, y = _data()
+    d = xgb.DMatrix(X, label=y)
+    xgb.train(_PARAMS, d, 30, verbose_eval=False)
+    walls = [r["wall_s"] for r in RECORDER.records()
+             if r.get("t") == "round"][-30:]
+    round_s = sorted(walls)[len(walls) // 2]
+    per_cycle = float("inf")
+    for _ in range(3):
+        n = 1000
+        t0 = time.perf_counter()
+        for i in range(n):
+            RECORDER.begin_round(i)
+            RECORDER.note("grow", 1e-3)
+            RECORDER.note("eval", 1e-3)
+            RECORDER.end_round()
+        per_cycle = min(per_cycle, (time.perf_counter() - t0) / n)
+    assert per_cycle < 0.02 * round_s, (
+        f"flight recorder cycle {per_cycle * 1e6:.1f}us exceeds 2% of a "
+        f"{round_s * 1e3:.2f}ms round")
+
+
+def test_rounds_per_second_decay_pin():
+    """VERDICT next-round #8 as a tier-1 guard: on a 200-round small CPU
+    run, the last 50 rounds must not be materially slower than the first
+    50 — catches accumulating per-round state (cache growth, leaked
+    buffers, O(trees) host work) that bench only sees as a worse total.
+    Medians keep the pin robust to scheduler noise and the first-window
+    compile rounds. Reuses the suite's standard shape: no extra
+    compile."""
+    X, y = _data(seed=3)
+    d = xgb.DMatrix(X, label=y)
+    xgb.train(_PARAMS, d, 200, verbose_eval=False)
+    walls = [r["wall_s"] for r in RECORDER.records()
+             if r.get("t") == "round"][-200:]
+    assert len(walls) == 200
+    first = sorted(walls[:50])[25]
+    last = sorted(walls[-50:])[25]
+    assert last <= 1.75 * first + 0.002, (
+        f"rounds/s decayed: median first-50 {first * 1e3:.2f}ms vs "
+        f"last-50 {last * 1e3:.2f}ms")
+
+
+# ---------------------------------------------------- histogram quantiles
+
+def test_histogram_quantile_estimation():
+    from xgboost_tpu.observability.metrics import Histogram
+
+    h = Histogram(buckets=(0.001, 0.01, 0.1, 1.0))
+    assert h.quantile(0.5) is None  # empty
+    for _ in range(90):
+        h.observe(0.005)
+    for _ in range(10):
+        h.observe(0.5)
+    p50, p99 = h.quantile(0.5), h.quantile(0.99)
+    assert 0.001 < p50 <= 0.01  # inside the 90%-bucket
+    assert 0.1 < p99 <= 1.0  # inside the tail bucket
+    h.observe(50.0)  # +Inf bucket: clamped to the largest finite bound
+    assert h.quantile(1.0) == 1.0
+
+
+def test_snapshot_exports_p50_p99_and_serving_latency():
+    reg_before = REGISTRY.get("predict_latency_seconds")
+    count0 = reg_before.labels().count if reg_before is not None else 0
+    X, y = _data()
+    d = xgb.DMatrix(X, label=y)
+    bst = xgb.train(_PARAMS, d, 2, verbose_eval=False)
+    for n in (1, 7, 100):
+        bst.inplace_predict(X[:n])
+    snap = REGISTRY.snapshot()
+    s = snap["predict_latency_seconds"]["series"][0]
+    assert s["count"] >= count0 + 3
+    assert s["p50"] is not None and s["p99"] is not None
+    assert 0 < s["p50"] <= s["p99"]
+    # round time rides the same histogram type (flight's round_seconds)
+    rs = snap["round_seconds"]["series"][0]
+    assert rs["count"] >= 2 and rs["p50"] is not None
+
+
+# ------------------------------------------------------------- obs-report
+
+def _synth_rank(obs_dir, rank, unix_ns, rounds, gen=0, events=(),
+                counters=None):
+    d = os.path.join(obs_dir, f"rank{rank}")
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, "clock.json"), "w") as f:
+        json.dump({"unix_ns": unix_ns, "ts_unit": "us"}, f)
+    with open(os.path.join(d, "flight.jsonl"), "w") as f:
+        f.write(json.dumps({"t": "meta", "rank": rank,
+                            "clock": {"unix_ns": unix_ns}}) + "\n")
+        for g, i, wall in rounds:
+            f.write(json.dumps({
+                "t": "round", "round": i, "rounds": 1, "gen": g,
+                "wall_s": wall, "stages": {"grow": wall * 0.8},
+                "unix_ms": unix_ns / 1e6 + i}) + "\n")
+        for name in events:
+            f.write(json.dumps({"t": "event", "name": name,
+                                "unix_ms": unix_ns / 1e6 + 50}) + "\n")
+    with open(os.path.join(d, "trace.jsonl"), "w") as f:
+        f.write("[\n")
+        for g, i, wall in rounds:
+            f.write(json.dumps({
+                "name": "round", "ph": "X", "ts": i * 1000,
+                "dur": int(wall * 1e6), "tid": 0, "pid": 0,
+                "args": {"iteration": i}}) + ",\n")
+    with open(os.path.join(d, "metrics.json"), "w") as f:
+        fams = {"rounds_total": {"type": "counter", "help": "", "series": [
+            {"labels": {}, "value": float(len(rounds))}]}}
+        for name, v in (counters or {}).items():
+            fams[name] = {"type": "counter", "help": "", "series": [
+                {"labels": {}, "value": float(v)}]}
+        fams["rss_peak_mb"] = {"type": "gauge", "help": "", "series": [
+            {"labels": {}, "value": 100.0 + rank}]}
+        json.dump(fams, f)
+    return d
+
+
+def test_obs_report_merges_ranks_clock_aligned(tmp_path, capsys):
+    from xgboost_tpu.cli import cli_main
+    from xgboost_tpu.observability.fleet import collect, fleet_table
+
+    run = str(tmp_path / "run")
+    obs = os.path.join(run, "obs")
+    base = 1_700_000_000_000_000_000
+    _synth_rank(obs, 0, base, [(0, i, 0.01) for i in range(4)],
+                events=["worker_lost", "elastic_quiesce", "elastic_resize"],
+                counters={"worker_restarts_total": 1})
+    # rank 1's clock started 3s later; it died after 2 rounds, then its
+    # flight file ends with a torn line (the SIGKILL signature)
+    d1 = _synth_rank(obs, 1, base + 3_000_000_000,
+                     [(0, 0, 0.012), (0, 1, 0.013)])
+    with open(os.path.join(d1, "flight.jsonl"), "a") as f:
+        f.write('{"t": "round", "round": 2, "tor')
+    assert cli_main(["obs-report", run]) == 0
+    out = capsys.readouterr().out
+    assert "2 rank(s)" in out and "worker_lost" in out
+
+    events = trace.load_trace(os.path.join(obs, "merged.trace.json"))
+    by_pid = {}
+    for e in events:
+        if e.get("ph") == "X":
+            by_pid.setdefault(e["pid"], []).append(e)
+    assert set(by_pid) == {0, 1}  # both ranks' round spans, pid = rank
+    # clock alignment: rank1's round 0 sits ~3s after rank0's round 0
+    t0 = min(e["ts"] for e in by_pid[0])
+    t1 = min(e["ts"] for e in by_pid[1])
+    assert abs((t1 - t0) - 3_000_000) < 1_000
+    names = {e.get("name") for e in events if e.get("ph") == "i"}
+    assert {"worker_lost", "elastic_quiesce", "elastic_resize"} <= names
+
+    roll = json.load(open(os.path.join(obs, "metrics_rollup.json")))
+    rounds_total = roll["rollup"]["rounds_total"]["series"][0]
+    assert rounds_total["value"] == 6.0  # summed across ranks
+    assert rounds_total["ranks"] == 2
+    assert roll["rollup"]["worker_restarts_total"]["series"][0]["value"] == 1
+    # gauges take the max across ranks
+    assert roll["rollup"]["rss_peak_mb"]["series"][0]["value"] == 101.0
+    # fleet table: per-round skew across ranks
+    table = fleet_table(collect(run))
+    row0 = [r for r in table["rounds"] if r["round"] == 0][0]
+    assert set(row0["ranks"]) == {"0", "1"}
+    assert row0["skew_s"] == pytest.approx(0.002)
+
+
+def test_obs_report_counts_replayed_rounds(tmp_path):
+    from xgboost_tpu.observability.fleet import collect, fleet_table
+
+    run = str(tmp_path / "run")
+    # generation 0 reached round 3; generation 1 replayed rounds 2-3
+    _synth_rank(os.path.join(run, "obs"), 0, 1_700_000_000_000_000_000,
+                [(0, 0, 0.01), (0, 1, 0.01), (0, 2, 0.01), (0, 3, 0.01),
+                 (1, 2, 0.01), (1, 3, 0.01), (1, 4, 0.01)])
+    table = fleet_table(collect(run))
+    assert table["replayed_rounds"] == 2
+
+
+def test_obs_report_empty_dir_fails(tmp_path):
+    from xgboost_tpu.cli import cli_main
+
+    assert cli_main(["obs-report", str(tmp_path)]) == 1
+
+
+def test_trace_report_accepts_globs_and_merges(tmp_path, capsys):
+    from xgboost_tpu.cli import cli_main
+
+    for r in (0, 1):
+        with open(tmp_path / f"t.json.rank{r}", "w") as f:
+            for k in range(2):
+                f.write(json.dumps({"name": f"phase{r}", "ph": "X",
+                                    "ts": 10 + 200 * k, "dur": 100,
+                                    "pid": r, "tid": 0}) + "\n")
+    assert cli_main(["trace-report", str(tmp_path / "t.json.rank*")]) == 0
+    out = capsys.readouterr().out
+    assert "merged 2 trace files" in out
+    assert "phase0" in out and "phase1" in out and "rank 1" in out
+    # unparseable events -> non-zero exit (satellite contract)
+    bad = tmp_path / "bad.json"
+    bad.write_text('{"name": "x", this is not json}\n')
+    assert cli_main(["trace-report", str(bad)]) == 1
+    # a bad file does not take the good ones down with it
+    assert cli_main(["trace-report", str(tmp_path / "t.json.rank0"),
+                     str(bad)]) == 1
+    assert "phase0" in capsys.readouterr().out
+
+
+# -------------------------------------------------------- profiling hooks
+
+def test_profile_env_captures_window(tmp_path, monkeypatch):
+    """Drives the train loop's profile_tick hook directly (one
+    start/stop cycle — the loop integration is a single call site and a
+    second jax.profiler session costs ~10s of tier-1 budget)."""
+    import jax
+    import jax.numpy as jnp
+
+    flight.profile_reset()
+    prof_dir = tmp_path / "prof"
+    monkeypatch.setenv("XGBTPU_PROFILE", str(prof_dir))
+    monkeypatch.setenv("XGBTPU_PROFILE_ROUNDS", "2")
+    flight.profile_tick(0)
+    if not flight._prof_state["active"]:  # no profiler backend: skip
+        pytest.skip("jax.profiler window failed to start on this build")
+    jnp.ones((64, 64)).sum().block_until_ready()  # something to profile
+    flight.profile_tick(1)
+    assert flight._prof_state["active"]  # window spans 2 rounds
+    flight.profile_tick(2)
+    assert not flight._prof_state["active"]  # closed on schedule
+    produced = [os.path.join(dp, f) for dp, _, fs in os.walk(prof_dir)
+                for f in fs]
+    assert produced, "profiler window produced no artifacts"
+    # once per process: a second window is refused, never re-armed
+    flight.profile_tick(0)
+    assert not flight._prof_state["active"]
+
+
+def test_cost_analysis_export_and_no_count(monkeypatch):
+    import jax.numpy as jnp
+
+    from xgboost_tpu.analysis.retrace import guard_jit, retrace_counts
+
+    monkeypatch.setenv("XGBTPU_COST_ANALYSIS", "1")
+    f = guard_jit(lambda x: (x @ x).sum(), name="flight_cost_demo")
+    f(jnp.ones((32, 32)))
+    f(jnp.ones((32, 32)))
+    # the AOT cost pass re-traces the body but must NOT count as a
+    # retrace (it is bookkeeping, not a new program)
+    assert retrace_counts()["flight_cost_demo"] == 1
+    snap = REGISTRY.snapshot()
+    flops = {s["labels"]["fn"]: s["value"]
+             for s in snap["xla_cost_flops"]["series"]}
+    nbytes = {s["labels"]["fn"]: s["value"]
+              for s in snap["xla_cost_bytes_accessed"]["series"]}
+    assert flops["flight_cost_demo"] > 0
+    assert nbytes["flight_cost_demo"] > 0

@@ -62,6 +62,7 @@ def test_root_matches_approx_depth1():
     assert abs(t_loc["split_condition"] - t_apx["split_condition"]) < 1e-6
 
 
+@pytest.mark.slow  # ~20s of tier-1 budget (1-core box); run with -m slow
 def test_trains_deep_and_deterministic():
     rng = np.random.RandomState(1)
     n = 4000
@@ -193,6 +194,7 @@ def test_rejects_quantile_dmatrix():
                   d, 1)
 
 
+@pytest.mark.slow  # ~37s of tier-1 budget (1-core box); run with -m slow
 def test_multiclass_and_parallel_trees():
     """K groups x num_parallel_tree trees per round through the local
     grower; softprob gradients are [n, K]."""

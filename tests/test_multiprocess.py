@@ -149,6 +149,7 @@ print(f"rank {rank} done", flush=True)
 """
 
 
+@pytest.mark.slow  # ~57s of tier-1 budget (1-core box); run with -m slow
 def test_two_process_large_eval_early_stop(tmp_path):
     """>=100k rows, uneven shards, eval set + early stopping through the
     public train(): metrics must be GLOBAL (dist_reduce) so both ranks

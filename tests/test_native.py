@@ -1,6 +1,8 @@
 """Native C++ parser tests (parity vs the pure-Python parser on the
 reference's own demo data)."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -10,7 +12,14 @@ AGARICUS = "/root/reference/demo/data/agaricus.txt.train"
 
 pytestmark = pytest.mark.skipif(get_lib() is None, reason="native lib unavailable")
 
+# the reference checkout (and its demo data) is not part of this
+# container image: parity-vs-demo-data tests skip rather than fail
+needs_reference_data = pytest.mark.skipif(
+    not os.path.exists(AGARICUS),
+    reason=f"reference demo data absent ({AGARICUS})")
 
+
+@needs_reference_data
 def test_native_libsvm_matches_python():
     from xgboost_tpu.data.adapters import _load_svmlight_py
 
@@ -79,6 +88,7 @@ def test_native_no_trailing_newline(tmp_path):
     assert X[0, 0] == pytest.approx(2.5)
 
 
+@needs_reference_data
 def test_dmatrix_uses_native_path():
     import xgboost_tpu as xgb
 

@@ -34,7 +34,11 @@ def _train_auc(X, y, method, extra=None, rounds=12):
     return bst, float(create_metric("auc").evaluate(pred, y[n_tr:]))
 
 
-@pytest.mark.parametrize("depth,max_bin", [(3, 32), (4, 256), (6, 64)])
+@pytest.mark.parametrize("depth,max_bin", [
+    (3, 32), (4, 256),
+    # the deep/wide sweep costs ~18s of the 1-core tier-1 budget
+    pytest.param(6, 64, marks=pytest.mark.slow),
+])
 def test_hist_exact_approx_auc_parity(depth, max_bin):
     """Same data, all three methods: test AUC within a small band of each
     other (the reference asserts near-equal eval histories across

@@ -22,6 +22,7 @@ __all__ = [
     "EvaluationMonitor",
     "TrainingCheckPoint",
     "TrainingTelemetry",
+    "FlightRecorderMonitor",
 ]
 
 _EvalsLog = Dict[str, Dict[str, List[float]]]
@@ -81,7 +82,13 @@ class CallbackContainer:
 
     def after_iteration(self, model, epoch, dtrain, evals, feval=None) -> bool:
         if evals:
+            import time
+
+            from .observability import flight
+
+            t0 = time.perf_counter()
             msg = model.eval_set(evals, epoch, feval)
+            flight.note("eval", time.perf_counter() - t0)
             self._update_history(msg)
         return any(cb.after_iteration(model, epoch, self.history) for cb in self.callbacks)
 
@@ -283,6 +290,49 @@ class TrainingTelemetry(TrainingCallback):
                     ).labels(data=dname, metric=mname).set(float(v))
         trace.instant("round", epoch=epoch)
         return False
+
+
+class FlightRecorderMonitor(TrainingCallback):
+    """Live window onto the flight recorder (ISSUE 7): after every round
+    the just-completed record (round wall time, grow/eval/checkpoint
+    stage seconds, retrace + collective deltas, memory watermarks —
+    ``observability/flight.py``) lands in ``self.latest`` and is handed
+    to ``on_record`` if given. The recorder itself is always on; this
+    callback only *reads* it, so attaching it costs nothing extra.
+
+    ::
+
+        mon = FlightRecorderMonitor(
+            on_record=lambda r: print(r["round"], r["wall_s"]))
+        xgb.train(params, dtrain, 100, callbacks=[mon])
+        mon.records()   # every record still in the ring
+    """
+
+    def __init__(self, on_record: Optional[Callable[[dict], None]] = None):
+        self.on_record = on_record
+        self.latest: Optional[dict] = None
+
+    def after_iteration(self, model, epoch: int, evals_log) -> bool:
+        from .observability import flight
+
+        # the loop's end_round() runs after the callbacks: the freshest
+        # COMPLETE record is the previous round's (epoch-1); the final
+        # round's record is picked up by after_training below
+        rec = flight.RECORDER.last()
+        if rec is not None and rec is not self.latest:
+            self.latest = rec
+            if self.on_record is not None:
+                self.on_record(rec)
+        return False
+
+    def after_training(self, model):
+        self.after_iteration(model, -1, None)
+        return model
+
+    def records(self) -> List[dict]:
+        from .observability import flight
+
+        return flight.RECORDER.records()
 
 
 class TrainingCheckPoint(TrainingCallback):

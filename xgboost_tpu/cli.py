@@ -4,14 +4,19 @@ Reference: ``src/cli_main.cc`` (CLITask :30-35, CLIParam :37) + the
 key=value config parser (``src/common/config.h``). Usage:
 
     python -m xgboost_tpu <config> [key=value ...]
-    python -m xgboost_tpu trace-report <trace-file> [--top N]
+    python -m xgboost_tpu trace-report <trace-file|glob> ... [--top N]
+    python -m xgboost_tpu obs-report <run_dir> [--top-rounds N]
     python -m xgboost_tpu checkpoint-inspect <dir>
 
 Config keys mirror the reference: task, data, test:data, model_in,
 model_out, model_dir, num_round, save_period, eval[name]=path, dump_format,
 name_pred, plus any booster/learner parameters. ``trace-report``
-summarizes a Chrome trace-event file written via ``XGBTPU_TRACE`` (top
-spans by self time, per-rank totals — ``docs/observability.md``).
+summarizes Chrome trace-event files written via ``XGBTPU_TRACE``
+(multiple/globbed inputs merge into one report: top spans by self time,
+per-rank totals — ``docs/observability.md``). ``obs-report`` merges a
+fleet run's per-rank observability (``run_dir/obs/rank<k>/``) into one
+clock-aligned trace, a metrics rollup and a per-round fleet table
+(``observability/fleet.py``).
 ``lint`` runs the static-analysis gate (trace-safety / retrace / dtype /
 concurrency passes, ``docs/static_analysis.md``):
 
@@ -82,6 +87,10 @@ def cli_main(argv: List[str]) -> int:
         from .observability.report import main as report_main
 
         return report_main(argv[1:])
+    if argv[0] == "obs-report":
+        from .observability.fleet import main as fleet_main
+
+        return fleet_main(argv[1:])
     if argv[0] == "lint":
         from .analysis.cli import main as lint_main
 

@@ -144,18 +144,22 @@ def compute_cuts(
     category code ``c`` lands in bin ``c`` — one bin per category, the same
     one-bin-per-category layout the reference builds for categorical data
     (``hist_util.cc`` AddCutPoint categorical path)."""
-    from ..observability import trace
+    import time
+
+    from ..observability import flight, trace
 
     X = jnp.asarray(X, dtype=jnp.float32)
     if weights is None or (hasattr(weights, "size") and weights.size == 0):
         weights = jnp.ones((X.shape[0],), dtype=jnp.float32)
     else:
         weights = jnp.asarray(weights, dtype=jnp.float32)
+    t0 = time.perf_counter()
     with trace.span("sketch", rows=int(X.shape[0]), features=int(X.shape[1]),
                     max_bin=max_bin):
         values, min_vals = _cuts_kernel(X, weights, max_bin)
         values = np.array(values)
         min_vals = np.array(min_vals)
+    flight.note("sketch", time.perf_counter() - t0)
     if categorical:
         apply_categorical_identity(values, min_vals, categorical)
     return HistogramCuts(values=values, min_vals=min_vals)

@@ -16,6 +16,7 @@ from __future__ import annotations
 import json
 import os
 import threading
+import time
 from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
@@ -264,26 +265,47 @@ class Booster:
             for i in range(start_iteration, start_iteration + num_rounds):
                 self.update(dtrain, i)
             return
+        from .observability import flight as _flight
+
         entry = self._caches.setdefault(id(dtrain), _PredCache())
         done = 0
         while done < num_rounds:
             k = min(chunk, num_rounds - done)
-            fault.begin_version(start_iteration + done)
-            fault.inject("gradient")
-            fault.inject("grow")
-            margin = self._cached_margin(dtrain)
-            info = dtrain.info
-            margin = self._gbm.boost_rounds_scan(
-                binned, self._obj,
-                jnp.asarray(info.label), info.weight, margin,
-                start_iteration + done, k,
-                feature_weights=info.feature_weights,
-            )
-            entry.margin = margin
-            entry.num_trees = self._gbm.model.num_trees
-            _REGISTRY.counter(
-                "rounds_total", "Boosting rounds dispatched").inc(k)
-            done += k
+            # one flight record per chunk (rounds=k): the scan path's
+            # dispatch cadence is per-chunk, so that is the granularity
+            # the recorder can honestly time. Under train()'s per-round
+            # loop (mesh: update -> 1-chunk scan) the begin is NESTED and
+            # owned stays False: the outer loop already times the whole
+            # update as "grow", so noting it here too would double-count.
+            owned = _flight.RECORDER.begin_round(
+                start_iteration + done, rounds=k)
+            # profiling is independent of the recorder: owned is False
+            # both for a nested begin (outer loop already ticks) AND when
+            # XGBTPU_FLIGHT=0 — the profiler window must still open then
+            if owned or not _flight.enabled():
+                _flight.profile_tick(start_iteration + done)
+            try:
+                fault.begin_version(start_iteration + done)
+                fault.inject("gradient")
+                fault.inject("grow")
+                margin = self._cached_margin(dtrain)
+                info = dtrain.info
+                _t0 = time.perf_counter()
+                margin = self._gbm.boost_rounds_scan(
+                    binned, self._obj,
+                    jnp.asarray(info.label), info.weight, margin,
+                    start_iteration + done, k,
+                    feature_weights=info.feature_weights,
+                )
+                if owned:
+                    _flight.note("grow", time.perf_counter() - _t0)
+                entry.margin = margin
+                entry.num_trees = self._gbm.model.num_trees
+                _REGISTRY.counter(
+                    "rounds_total", "Boosting rounds dispatched").inc(k)
+                done += k
+            finally:
+                _flight.RECORDER.end_round()
 
     def boost(self, dtrain: DMatrix, grad, hess) -> None:
         """Custom-objective boost (reference BoostOneIter learner.cc:1088)."""

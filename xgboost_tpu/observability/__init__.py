@@ -12,13 +12,20 @@ accumulators, NVTX ranges, ``TrainingObserver`` dumps):
   (``utils.timer.Monitor`` feeds it as a thin adapter);
 - ``comms`` — collective ops/bytes accounting for ``collective.py`` and
   the mesh psum / all_gather paths;
-- ``report`` — the ``python -m xgboost_tpu trace-report`` summarizer.
+- ``flight`` — the always-on per-round flight recorder (ring buffer,
+  durable ``run_dir/obs/rank<k>/`` sink, black-box dumps, profiling
+  window) — ISSUE 7;
+- ``report`` — the ``python -m xgboost_tpu trace-report`` summarizer;
+- ``fleet`` — the ``python -m xgboost_tpu obs-report`` cross-rank
+  merger (clock-aligned trace, metrics rollup, per-round fleet table).
 
 Everything is a no-op costing one branch per call site when disabled, and
 never records from inside ``jit``-traced code (host-side only).
 """
 
 from . import comms, metrics, trace  # noqa: F401
+from . import flight  # noqa: F401  (after trace/metrics: it builds on both)
+from .flight import RECORDER  # noqa: F401
 from .metrics import REGISTRY, MetricsRegistry, get_registry  # noqa: F401
 from .trace import (  # noqa: F401
     emit,
@@ -31,8 +38,8 @@ from .trace import (  # noqa: F401
 )
 
 __all__ = [
-    "trace", "metrics", "comms",
+    "trace", "metrics", "comms", "flight",
     "span", "instant", "emit", "enabled", "flush", "trace_path",
     "load_trace",
-    "REGISTRY", "MetricsRegistry", "get_registry",
+    "REGISTRY", "MetricsRegistry", "get_registry", "RECORDER",
 ]

@@ -1,0 +1,404 @@
+"""Cross-rank observability aggregation: ``python -m xgboost_tpu obs-report``.
+
+Every rank of a fleet run persists its own telemetry under
+``run_dir/obs/rank<k>/`` (``observability/flight.py``): ``flight.jsonl``
+(per-round records + fleet events), ``trace.jsonl`` (span timeline),
+``metrics.json`` (registry snapshot) and ``clock.json`` (the wall-clock
+instant at which that rank's trace timestamps are zero). Per-rank files
+answer per-rank questions; the fleet's questions — who straggled, when
+was the death detected, what did the whole world spend — need the ranks
+merged. This module is that merge (the reference's rabit tracker had the
+reduce built into the protocol; here it is an offline pass over the
+run directory, so it also works on the wreckage of a crashed run):
+
+- **merged trace** — every rank's events on one clock-aligned timeline
+  (each rank's ``ts`` is shifted by its recorded clock offset; Chrome
+  ``pid`` = base rank), with flight events (worker loss, tombstones,
+  quiesce/resize/replay, degrade transitions, watchdog aborts) rendered
+  as instant events. Written to ``run_dir/obs/merged.trace.json`` —
+  loadable in Perfetto like any single-rank trace.
+- **metrics rollup** — counters summed across ranks, gauges maxed,
+  histograms merged (sums/counts/buckets added). Written to
+  ``run_dir/obs/metrics_rollup.json``.
+- **per-round fleet table** — each round's wall time per rank, the
+  straggler skew (max-min), and replay accounting (a (gen, round) pair
+  recorded twice by one rank is a replayed round).
+
+Partial data is expected input, not an error: a SIGKILLed rank's last
+JSONL line may be torn (skipped), a rank that died before its first
+round has only a meta line, and a missing ``clock.json`` degrades that
+rank to unshifted timestamps.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+from collections import defaultdict
+from typing import Any, Dict, List, Optional, Tuple
+
+from .trace import load_trace
+
+__all__ = ["collect", "merge_trace", "rollup_metrics", "fleet_table",
+           "format_fleet_report", "main"]
+
+_RANK_RE = re.compile(r"^rank(\d+)$")
+
+
+class RankObs:
+    """One rank's persisted observability files, parsed leniently."""
+
+    def __init__(self, rank: int, path: str):
+        self.rank = rank
+        self.path = path
+        self.clock_unix_ns: Optional[int] = None
+        self.trace_events: List[Dict[str, Any]] = []
+        self.flight: List[Dict[str, Any]] = []
+        self.metrics: Dict[str, Any] = {}
+        self.errors: List[str] = []
+
+    def load(self) -> "RankObs":
+        clock = self._read_json("clock.json")
+        if isinstance(clock, dict) and "unix_ns" in clock:
+            self.clock_unix_ns = int(clock["unix_ns"])
+        tr = os.path.join(self.path, "trace.jsonl")
+        if os.path.exists(tr):
+            try:
+                self.trace_events = load_trace(tr)
+            except (OSError, ValueError) as e:
+                self.errors.append(f"trace.jsonl: {e}")
+        fl = os.path.join(self.path, "flight.jsonl")
+        if os.path.exists(fl):
+            self.flight = self._read_jsonl(fl)
+        metrics = self._read_json("metrics.json")
+        if isinstance(metrics, dict):
+            self.metrics = metrics
+        # the black box also carries a metrics snapshot — prefer it only
+        # when it is the NEWER file: after a completed/quiesced run it
+        # postdates the last per-round metrics.json refresh, but a stale
+        # blackbox.json left by an earlier abort of a since-resumed run
+        # must not mask the live snapshot
+        bb = self._read_json("blackbox.json")
+        if isinstance(bb, dict) and isinstance(bb.get("metrics"), dict) \
+                and bb["metrics"] and (not self.metrics or self._mtime(
+                    "blackbox.json") >= self._mtime("metrics.json")):
+            self.metrics = bb["metrics"]
+        if not self.flight and isinstance(bb, dict):
+            self.flight = [r for r in bb.get("records", [])
+                           if isinstance(r, dict)]
+        return self
+
+    def _mtime(self, name: str) -> float:
+        try:
+            return os.path.getmtime(os.path.join(self.path, name))
+        except OSError:
+            return 0.0
+
+    def _read_json(self, name: str) -> Any:
+        try:
+            with open(os.path.join(self.path, name)) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def _read_jsonl(self, path: str) -> List[Dict[str, Any]]:
+        out: List[Dict[str, Any]] = []
+        try:
+            with open(path) as f:
+                lines = f.read().splitlines()
+        except OSError as e:
+            self.errors.append(f"{os.path.basename(path)}: {e}")
+            return out
+        for i, ln in enumerate(lines):
+            ln = ln.strip()
+            if not ln:
+                continue
+            try:
+                rec = json.loads(ln)
+                if isinstance(rec, dict):
+                    out.append(rec)
+            except ValueError:
+                if i == len(lines) - 1:
+                    continue  # torn final line: the SIGKILL signature
+                self.errors.append(
+                    f"{os.path.basename(path)}: bad record at line {i + 1}")
+        return out
+
+
+def collect(run_dir: str) -> List[RankObs]:
+    """Every ``rank<k>`` directory under ``run_dir/obs``, loaded."""
+    obs = os.path.join(run_dir, "obs")
+    ranks: List[RankObs] = []
+    try:
+        names = sorted(os.listdir(obs))
+    except OSError:
+        return ranks
+    for name in names:
+        m = _RANK_RE.match(name)
+        sub = os.path.join(obs, name)
+        if m and os.path.isdir(sub):
+            ranks.append(RankObs(int(m.group(1)), sub).load())
+    return sorted(ranks, key=lambda r: r.rank)
+
+
+# ---------------------------------------------------------------------------
+# merged trace
+# ---------------------------------------------------------------------------
+
+def merge_trace(ranks: List[RankObs]) -> List[Dict[str, Any]]:
+    """One clock-aligned event list: the earliest recorded clock base is
+    t=0's wall-clock anchor; each rank's events shift by its offset from
+    that anchor and take the rank as ``pid``. Flight events become
+    Chrome instants (phase 'i', process scope) so membership/degrade/
+    elastic transitions are visible even for a rank whose trace ring
+    never flushed."""
+    bases = [r.clock_unix_ns for r in ranks if r.clock_unix_ns is not None]
+    anchor_ns = min(bases) if bases else 0
+    merged: List[Dict[str, Any]] = []
+    for r in ranks:
+        merged.append({
+            "name": "process_name", "ph": "M", "pid": r.rank, "tid": 0,
+            "args": {"name": f"xgboost_tpu rank {r.rank}"},
+        })
+        shift_us = 0
+        if r.clock_unix_ns is not None and anchor_ns:
+            shift_us = (r.clock_unix_ns - anchor_ns) // 1000
+        for ev in r.trace_events:
+            if ev.get("ph") == "M":
+                continue  # regenerated above with the base rank as pid
+            ev = dict(ev)
+            ev["pid"] = r.rank
+            if "ts" in ev:
+                ev["ts"] = int(ev["ts"]) + shift_us
+            merged.append(ev)
+        for rec in r.flight:
+            if rec.get("t") != "event" or "unix_ms" not in rec:
+                continue
+            ts = int(rec["unix_ms"] * 1000) - anchor_ns // 1000
+            merged.append({
+                "name": rec.get("name", "event"), "ph": "i", "s": "p",
+                "ts": max(ts, 0), "pid": r.rank, "tid": 0,
+                "args": rec.get("args", {}),
+            })
+    return merged
+
+
+def write_trace(path: str, events: List[Dict[str, Any]]) -> None:
+    """The same trailing-comma array-of-lines form ``trace.flush``
+    writes (Perfetto/chrome://tracing-loadable, line-parseable)."""
+    with open(path, "w") as f:
+        f.write("[\n")
+        for ev in events:
+            f.write(json.dumps(ev) + ",\n")
+
+
+# ---------------------------------------------------------------------------
+# metrics rollup
+# ---------------------------------------------------------------------------
+
+def rollup_metrics(ranks: List[RankObs]) -> Dict[str, Any]:
+    """Fleet-wide registry view: counters and histogram sums/counts/
+    buckets ADD across ranks (total work done); gauges take the MAX
+    (watermarks and state codes — ``degrade_state``'s worst-state
+    encoding and memory peaks both want the maximum; a mean would
+    describe no rank at all)."""
+    out: Dict[str, Any] = {}
+    for r in ranks:
+        for name, fam in (r.metrics or {}).items():
+            if not isinstance(fam, dict) or "series" not in fam:
+                continue
+            dst = out.setdefault(name, {
+                "type": fam.get("type", "gauge"),
+                "help": fam.get("help", ""),
+                "series": {},
+            })
+            for s in fam["series"]:
+                key = tuple(sorted((s.get("labels") or {}).items()))
+                if dst["type"] == "histogram":
+                    agg = dst["series"].setdefault(key, {
+                        "labels": dict(key), "sum": 0.0, "count": 0,
+                        "buckets": defaultdict(int), "ranks": 0,
+                    })
+                    agg["sum"] += float(s.get("sum", 0.0))
+                    agg["count"] += int(s.get("count", 0))
+                    for ub, c in (s.get("buckets") or {}).items():
+                        agg["buckets"][ub] += int(c)
+                    agg["ranks"] += 1
+                else:
+                    agg = dst["series"].setdefault(key, {
+                        "labels": dict(key), "value": 0.0, "ranks": 0,
+                    })
+                    v = float(s.get("value", 0.0))
+                    if dst["type"] == "counter":
+                        agg["value"] += v
+                    else:
+                        agg["value"] = v if agg["ranks"] == 0 \
+                            else max(agg["value"], v)
+                    agg["ranks"] += 1
+    for fam in out.values():
+        series = []
+        for _, agg in sorted(fam["series"].items()):
+            if "buckets" in agg:
+                agg["buckets"] = dict(agg["buckets"])
+            series.append(agg)
+        fam["series"] = series
+    return out
+
+
+# ---------------------------------------------------------------------------
+# per-round fleet table
+# ---------------------------------------------------------------------------
+
+def fleet_table(ranks: List[RankObs]) -> Dict[str, Any]:
+    """Round-by-round wall times across ranks. Keyed (generation, round):
+    ``per_round[(g, i)] = {rank: wall_s}``. ``replayed`` counts (rank,
+    gen-crossing) repeats of a round index — the rounds elastic recovery
+    re-trained. ``skew`` per round is max-min wall seconds across the
+    ranks that recorded it (the straggler gap the async executor of
+    ROADMAP 3 must close)."""
+    per_round: Dict[Tuple[int, int], Dict[int, float]] = defaultdict(dict)
+    replayed = 0
+    for r in ranks:
+        seen: set = set()
+        for rec in r.flight:
+            if rec.get("t") != "round" or "wall_s" not in rec:
+                continue
+            base = int(rec.get("round", -1))
+            n = max(int(rec.get("rounds", 1)), 1)
+            gen = int(rec.get("gen", 0))
+            for i in range(base, base + n):
+                if i in seen:
+                    replayed += 1
+                seen.add(i)
+                # chunk records spread their wall evenly; per-round
+                # records (n == 1) keep it exact
+                per_round[(gen, i)][r.rank] = rec["wall_s"] / n
+    rows = []
+    for (gen, i), by_rank in sorted(per_round.items()):
+        walls = list(by_rank.values())
+        rows.append({
+            "gen": gen, "round": i,
+            "ranks": {str(k): round(v, 6) for k, v in sorted(
+                by_rank.items())},
+            "skew_s": round(max(walls) - min(walls), 6),
+        })
+    return {"rounds": rows, "replayed_rounds": replayed}
+
+
+# ---------------------------------------------------------------------------
+# report
+# ---------------------------------------------------------------------------
+
+def _fmt_labels(labels: Dict[str, str]) -> str:
+    if not labels:
+        return ""
+    return "{" + ",".join(f'{k}="{v}"' for k, v in sorted(labels.items())) \
+        + "}"
+
+
+def format_fleet_report(ranks: List[RankObs], rollup: Dict[str, Any],
+                        table: Dict[str, Any], top_rounds: int = 10) -> str:
+    lines = [f"obs-report: {len(ranks)} rank(s)"]
+    for r in ranks:
+        n_rounds = sum(1 for rec in r.flight if rec.get("t") == "round")
+        n_events = sum(1 for rec in r.flight if rec.get("t") == "event")
+        lines.append(
+            f"  rank {r.rank}: {n_rounds} round records, {n_events} "
+            f"events, {len(r.trace_events)} trace events"
+            + (f", {len(r.errors)} parse errors" if r.errors else ""))
+        for err in r.errors:
+            lines.append(f"    ! {err}")
+    events: Dict[str, int] = defaultdict(int)
+    for r in ranks:
+        for rec in r.flight:
+            if rec.get("t") == "event":
+                events[rec.get("name", "?")] += 1
+    if events:
+        lines.append("")
+        lines.append("fleet events:")
+        for name in sorted(events):
+            lines.append(f"  {name}: {events[name]}")
+    rows = table["rounds"]
+    if rows:
+        lines.append("")
+        multi = any(len(row["ranks"]) > 1 for row in rows)
+        total = sum(sum(row["ranks"].values()) for row in rows)
+        lines.append(
+            f"per-round fleet table: {len(rows)} (gen, round) entries, "
+            f"{table['replayed_rounds']} replayed, "
+            f"{total:.3f}s total round wall")
+        show = sorted(rows, key=lambda r: -r["skew_s"])[:top_rounds] \
+            if multi else rows[:top_rounds]
+        lines.append(f"  {'gen':>4} {'round':>6} {'skew':>10}  per-rank s")
+        for row in sorted(show, key=lambda r: (r["gen"], r["round"])):
+            per = " ".join(f"r{k}={v:.3f}"
+                           for k, v in row["ranks"].items())
+            lines.append(f"  {row['gen']:>4} {row['round']:>6} "
+                         f"{row['skew_s'] * 1e3:>8.2f}ms  {per}")
+        if len(rows) > len(show):
+            lines.append(f"  ... ({len(rows) - len(show)} more; "
+                         "full table in metrics_rollup.json's sidecar)")
+    counters = []
+    for name, fam in sorted(rollup.items()):
+        if fam["type"] != "counter":
+            continue
+        for s in fam["series"]:
+            counters.append((name + _fmt_labels(s["labels"]), s["value"],
+                             s["ranks"]))
+    if counters:
+        lines.append("")
+        lines.append("metrics rollup (counters summed across ranks):")
+        for name, value, nr in counters:
+            lines.append(f"  {name} = {value:g}  [{nr} rank(s)]")
+    for name, fam in sorted(rollup.items()):
+        if fam["type"] != "histogram":
+            continue
+        for s in fam["series"]:
+            if s["count"]:
+                lines.append(
+                    f"  {name}{_fmt_labels(s['labels'])}: count={s['count']} "
+                    f"mean={s['sum'] / s['count'] * 1e3:.3f}ms")
+    return "\n".join(lines)
+
+
+def main(argv: List[str]) -> int:
+    usage = ("usage: python -m xgboost_tpu obs-report <run_dir> "
+             "[--top-rounds N]")
+    if not argv or argv[0] in ("-h", "--help"):
+        print(usage, file=sys.stderr)
+        return 0 if argv else 1
+    top_rounds = 10
+    if "--top-rounds" in argv:
+        i = argv.index("--top-rounds")
+        try:
+            top_rounds = int(argv[i + 1])
+        except (IndexError, ValueError):
+            print(usage, file=sys.stderr)
+            return 1
+        argv = argv[:i] + argv[i + 2:]
+    run_dir = argv[0]
+    ranks = collect(run_dir)
+    if not ranks:
+        print(f"{run_dir}: no obs/rank<k> directories found (was the run "
+              "launched with a flight-recorder sink? docs/observability.md)",
+              file=sys.stderr)
+        return 1
+    merged = merge_trace(ranks)
+    rollup = rollup_metrics(ranks)
+    table = fleet_table(ranks)
+    obs = os.path.join(run_dir, "obs")
+    trace_out = os.path.join(obs, "merged.trace.json")
+    rollup_out = os.path.join(obs, "metrics_rollup.json")
+    try:
+        write_trace(trace_out, merged)
+        with open(rollup_out, "w") as f:
+            json.dump({"rollup": rollup, "fleet_table": table}, f)
+    except OSError as e:
+        print(f"obs-report: cannot write outputs: {e}", file=sys.stderr)
+        return 1
+    print(format_fleet_report(ranks, rollup, table, top_rounds=top_rounds))
+    print(f"\nmerged trace -> {trace_out} ({len(merged)} events)")
+    print(f"metrics rollup -> {rollup_out}")
+    return 0

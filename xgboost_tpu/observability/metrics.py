@@ -106,6 +106,28 @@ class Histogram:
             out.append(acc)
         return out
 
+    def quantile(self, q: float) -> Optional[float]:
+        """Estimated q-quantile (0 < q <= 1) from the bucket counts —
+        Prometheus ``histogram_quantile`` semantics: linear interpolation
+        inside the bucket the target rank falls in, clamped to the
+        largest finite bound when the rank lands in the +Inf bucket.
+        None when nothing was observed. The estimate's resolution is the
+        bucket ladder (choose buckets for the latencies you care about);
+        p50/p99 from this are what the serving latency and round-time
+        series report (docs/observability.md)."""
+        if self.count == 0:
+            return None
+        target = max(min(float(q), 1.0), 0.0) * self.count
+        cum = 0.0
+        lo = 0.0
+        for ub, c in zip(self.buckets, self.counts):
+            if c and cum + c >= target:
+                frac = (target - cum) / c
+                return lo + (ub - lo) * min(max(frac, 0.0), 1.0)
+            cum += c
+            lo = ub
+        return float(self.buckets[-1])  # +Inf bucket: clamp
+
 
 _KINDS = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
 
@@ -262,6 +284,8 @@ class MetricsRegistry:
                         "labels": labels,
                         "sum": child.sum,
                         "count": child.count,
+                        "p50": child.quantile(0.50),
+                        "p99": child.quantile(0.99),
                         "buckets": {
                             _fmt_value(ub): c
                             for ub, c in zip(child.buckets,

@@ -13,10 +13,18 @@ Self time is reconstructed per (pid, tid) track with a stack sweep over
 the complete ('X') events sorted by start time: an event strictly
 contained in the open event above it is a child, and its duration is
 subtracted from the parent's self time.
+
+Multiple inputs (and shell-unexpanded globs — ``trace.json.rank*``) are
+merged into ONE report: per-rank files from a multi-process run carry
+their rank as the Chrome ``pid``, so the per-rank totals stay separable
+after the merge and nobody has to concatenate JSONL by hand. Any
+unreadable or unparseable input makes the exit status non-zero (the
+readable inputs still report).
 """
 
 from __future__ import annotations
 
+import glob as _glob
 import sys
 from collections import defaultdict
 from typing import Any, Dict, Iterable, List, Tuple
@@ -118,10 +126,22 @@ def format_report(summary: Dict[str, Any], top: int = 20) -> str:
     return "\n".join(lines)
 
 
+def expand_inputs(args: List[str]) -> List[str]:
+    """Glob-expand each argument (sorted); an argument matching nothing
+    is kept literally so its load error surfaces instead of silently
+    reporting on fewer files than asked for."""
+    paths: List[str] = []
+    for pat in args:
+        hits = sorted(_glob.glob(pat))
+        paths.extend(hits if hits else [pat])
+    return paths
+
+
 def main(argv: List[str]) -> int:
+    usage = ("usage: python -m xgboost_tpu trace-report <trace-file|glob>"
+             " [more files...] [--top N]")
     if not argv or argv[0] in ("-h", "--help"):
-        print("usage: python -m xgboost_tpu trace-report <trace-file> "
-              "[--top N]", file=sys.stderr)
+        print(usage, file=sys.stderr)
         return 0 if argv else 1
     top = 20
     if "--top" in argv:
@@ -129,19 +149,22 @@ def main(argv: List[str]) -> int:
         try:
             top = int(argv[i + 1])
         except (IndexError, ValueError):
-            print("usage: python -m xgboost_tpu trace-report <trace-file> "
-                  "[--top N]", file=sys.stderr)
+            print(usage, file=sys.stderr)
             return 1
         argv = argv[:i] + argv[i + 2:]
     rc = 0
-    for path in argv:
+    events: List[Dict[str, Any]] = []
+    loaded: List[str] = []
+    for path in expand_inputs(argv):
         try:
-            events = load_trace(path)
+            events.extend(load_trace(path))
         except (OSError, ValueError, KeyError) as e:
             print(f"{path}: unreadable trace: {e}", file=sys.stderr)
             rc = 1
             continue
-        if len(argv) > 1:
-            print(f"== {path} ==")
+        loaded.append(path)
+    if loaded:
+        if len(loaded) > 1:
+            print(f"== merged {len(loaded)} trace files ==")
         print(format_report(summarize(events), top=top))
     return rc

@@ -46,7 +46,7 @@ from typing import Any, Dict, List, Optional
 
 __all__ = [
     "span", "instant", "emit", "enabled", "trace_path", "flush", "reset",
-    "load_trace",
+    "load_trace", "clock_base", "set_sink",
 ]
 
 _ENV_PATH = "XGBTPU_TRACE"
@@ -59,7 +59,31 @@ _dropped = 0
 _headers_written: set = set()
 _tid_map: Dict[int, int] = {}
 _rank_cache: Optional[tuple] = None  # (rank, world)
+_sink: Optional[str] = None  # flight-recorder sink (observability/flight.py)
+# the two clock reads are adjacent on purpose: _EPOCH_UNIX_NS is the
+# wall-clock instant at which event timestamps are 0, the per-rank clock
+# base cross-rank merging aligns on (obs-report; skew < 1us)
 _EPOCH_NS = time.perf_counter_ns()
+_EPOCH_UNIX_NS = time.time_ns()
+
+
+def clock_base() -> Dict[str, Any]:
+    """The mapping from this process's event timestamps to wall-clock
+    time: an event's ``ts`` (microseconds) is relative to ``unix_ns``.
+    Persisted per rank (``obs/rank<k>/clock.json``) so ``obs-report``
+    can merge ranks onto one clock-aligned timeline."""
+    return {"unix_ns": _EPOCH_UNIX_NS, "ts_unit": "us"}
+
+
+def set_sink(path: Optional[str]) -> None:
+    """Install (or clear) a process-wide fallback trace destination —
+    the flight recorder's per-rank ``trace.jsonl``. Explicit choices
+    (``XGBTPU_TRACE``, ``set_config(trace_path=...)``) still win, and a
+    sink path is written EXACTLY (no ``.rank<r>`` suffix: the sink is
+    already rank-scoped)."""
+    global _sink
+    with _lock:
+        _sink = path
 
 
 def trace_path() -> Optional[str]:
@@ -71,7 +95,7 @@ def trace_path() -> Optional[str]:
         return p
     from ..config import _state  # direct read: no per-span dict copy
 
-    return _state().get("trace_path") or None
+    return _state().get("trace_path") or _sink or None
 
 
 def enabled() -> bool:
@@ -219,6 +243,8 @@ def instant(name: str, **args: Any) -> None:
 
 
 def _out_path(path: str) -> str:
+    if path == _sink:
+        return path  # the sink is already a rank-scoped destination
     rank, world = _rank_world()
     return f"{path}.rank{rank}" if world > 1 else path
 

@@ -266,6 +266,10 @@ class Membership:
                 "Membership transitions (worker joins and losses)").inc()
             trace.instant("worker_lost", rank=p,
                           generation=self.generation)
+            from ..observability import flight
+
+            flight.RECORDER.event("worker_lost", rank=p,
+                                  generation=self.generation)
             from ..utils import console_logger
 
             console_logger.warning(
@@ -298,6 +302,10 @@ class Membership:
             except OSError:
                 pass
             trace.instant("worker_tombstoned", rank=rank, by=self.rank)
+            from ..observability import flight
+
+            flight.RECORDER.event("worker_tombstoned", rank=rank,
+                                  by=self.rank)
         with self._lock:
             if rank != self.rank:
                 self._dead.add(rank)

@@ -39,6 +39,7 @@ from __future__ import annotations
 import functools
 import os
 import threading
+import time
 from collections import OrderedDict
 from typing import Callable, Optional, Tuple
 
@@ -364,6 +365,15 @@ def _transform_bucketed(margin: np.ndarray, transform: Callable,
     return out[:n]
 
 
+# serving latencies live between ~30us (native walker, small batch) and
+# whole-second cold compiles — the default seconds ladder is too coarse
+# at the fast end for a meaningful p50
+_LATENCY_BUCKETS = (
+    0.00001, 0.000025, 0.00005, 0.0001, 0.00025, 0.0005, 0.001, 0.0025,
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+
 def predict_serving(
     forest: StackedForest,
     X: np.ndarray,
@@ -379,7 +389,30 @@ def predict_serving(
     densification. ``base`` is ``[n, K]``; ``transform`` (an objective's
     traceable ``pred_transform``) is fused into the compiled program (or
     applied once post-walk on the native route). Returns a host numpy
-    array of ``n`` rows."""
+    array of ``n`` rows.
+
+    Every request observes into the ``predict_latency_seconds``
+    histogram (p50/p99 via ``REGISTRY.snapshot()`` — ISSUE 7), so a
+    serving frontend's tail latency is scrapeable without wrapping this
+    call."""
+    t0 = time.perf_counter()
+    out = _predict_serving_impl(forest, X, base, tree_weights, transform,
+                                cache)
+    _REGISTRY.histogram(
+        "predict_latency_seconds",
+        "End-to-end serving predict latency per request",
+        buckets=_LATENCY_BUCKETS).observe(time.perf_counter() - t0)
+    return out
+
+
+def _predict_serving_impl(
+    forest: StackedForest,
+    X: np.ndarray,
+    base: np.ndarray,
+    tree_weights: Optional[jax.Array] = None,
+    transform: Optional[Callable] = None,
+    cache: Optional[ServingCache] = None,
+) -> np.ndarray:
     cache = cache or SERVING_CACHE
     if hasattr(X, "tocsr") and not hasattr(X, "dense_rows"):
         # raw scipy input: wrap so absent-entry-is-NaN densification has

@@ -111,8 +111,10 @@ def save_checkpoint(directory: str, booster, rounds: int, *,
     the one in flight). The write itself runs under the ``checkpoint_write``
     retry policy — transient IO faults (including injected chaos) are
     absorbed up to the ``XGBTPU_RETRY`` budget (default 2 retries)."""
+    import time
+
     from ..observability.metrics import REGISTRY
-    from ..observability import trace
+    from ..observability import flight, trace
 
     payload = booster.save_raw()
     header = json.dumps({
@@ -122,10 +124,12 @@ def save_checkpoint(directory: str, booster, rounds: int, *,
         "payload_bytes": len(payload),
     }).encode()
     path = checkpoint_path(directory, rounds)
+    t0 = time.perf_counter()
     with trace.span("checkpoint_write", rounds=int(rounds),
                     bytes=len(payload)):
         policy.RetryPolicy("checkpoint_write", retries=2).run(
             _write_atomic, path, header, payload)
+    flight.note("checkpoint", time.perf_counter() - t0)
     REGISTRY.counter(
         "checkpoints_written_total", "Atomic checkpoints committed").inc()
     for old in list_checkpoints(directory)[:-retain] if retain else []:

@@ -58,12 +58,14 @@ def _publish(name: str, state: int) -> None:
 
 def _announce(name: str, key: Hashable, old: int, new: int,
               detail: str) -> None:
-    from ..observability import trace
+    from ..observability import flight, trace
     from ..utils import console_logger
 
     trace.instant("degrade_transition", capability=name,
                   key=repr(key) if key is not None else "",
                   frm=STATE_NAMES[old], to=STATE_NAMES[new])
+    flight.RECORDER.event("degrade_transition", capability=name,
+                          frm=STATE_NAMES[old], to=STATE_NAMES[new])
     msg = (f"capability {name!r}"
            + (f" key={key!r}" if key is not None else "")
            + f": {STATE_NAMES[old]} -> {STATE_NAMES[new]} ({detail})")

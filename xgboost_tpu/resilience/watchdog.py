@@ -102,7 +102,7 @@ def watchdog(site: str, seconds: Optional[float] = None,
         _thread.interrupt_main()
         try:  # best-effort telemetry AFTER the abort is in flight
             from ..observability.metrics import REGISTRY
-            from ..observability import trace
+            from ..observability import flight, trace
             from ..utils import console_logger
 
             REGISTRY.counter(
@@ -110,6 +110,11 @@ def watchdog(site: str, seconds: Optional[float] = None,
                 "Deadline expiries by watchdogged site",
             ).labels(site=site).inc()
             trace.instant("watchdog_timeout", site=site, seconds=seconds)
+            # black-box dump from THIS thread: the main thread may be too
+            # wedged to ever reach train()'s abort handler
+            flight.RECORDER.event("watchdog_timeout", site=site,
+                                  seconds=seconds)
+            flight.RECORDER.dump(f"watchdog:{site}")
             console_logger.warning(
                 f"watchdog: {site!r} still running after {seconds:g}s — "
                 "interrupting the main thread")

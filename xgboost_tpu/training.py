@@ -6,6 +6,7 @@ docs/distributed.md, "Elastic training")."""
 from __future__ import annotations
 
 import os
+import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -128,6 +129,7 @@ def train(
 
     import jax
 
+    from .observability import flight as _flight
     from .observability import trace as _trace
     from .resilience.watchdog import watchdog as _watchdog
 
@@ -165,25 +167,39 @@ def train(
                 for i in range(start_round, start_round + num_boost_round):
                     if container.before_iteration(bst, i, dtrain, evals):
                         break
-                    with _trace.span("round", iteration=i):
-                        # deadline around the per-round host dispatch
-                        # (off unless XGBTPU_WATCHDOG names round_dispatch
-                        # or *): a wedged relay aborts cleanly — raise +
-                        # checkpoint — instead of hanging the run
-                        with _watchdog("round_dispatch"):
-                            bst.update(dtrain, i, fobj=obj)
-                        stop = container.after_iteration(
-                            bst, i, dtrain, evals, feval=feval)
+                    _flight.profile_tick(i)
+                    _flight.RECORDER.begin_round(i)
+                    try:
+                        with _trace.span("round", iteration=i):
+                            # deadline around the per-round host dispatch
+                            # (off unless XGBTPU_WATCHDOG names
+                            # round_dispatch or *): a wedged relay aborts
+                            # cleanly — raise + checkpoint — instead of
+                            # hanging the run
+                            _t0 = time.perf_counter()
+                            with _watchdog("round_dispatch"):
+                                bst.update(dtrain, i, fobj=obj)
+                            # host-blocked time around the round dispatch:
+                            # the number ROADMAP 3's async executor exists
+                            # to shrink, recorded per round from day one
+                            _flight.note("grow", time.perf_counter() - _t0)
+                            stop = container.after_iteration(
+                                bst, i, dtrain, evals, feval=feval)
+                    finally:
+                        _flight.RECORDER.end_round()
                     if stop:
                         break
-    except BaseException:
+    except BaseException as e:
         # ANY abort mid-loop — watchdog expiry, a collective failing
         # because a peer died, an elastic guard raising WorkerLost —
         # flushes the last consistent rounds as a checkpoint before
         # surfacing: this is the quiesce half of the elastic contract
         # (the resize half replays from exactly this snapshot)
         _commit_on_abort()
+        _flight.RECORDER.abort_dump(e)  # black box: ring + metrics
         raise
+    finally:
+        _flight.profile_stop()
 
     bst = container.after_training(bst)
 
@@ -366,6 +382,7 @@ def elastic_train(
     newest verified checkpoint) -> TRAIN.
     """
     from .observability.metrics import REGISTRY
+    from .observability import flight as _flight
     from .observability import trace as _trace
     from .parallel.membership import Membership, WorkerLost, hb_deadline
     from .parallel.mesh import mesh_context
@@ -373,6 +390,10 @@ def elastic_train(
     from .utils import console_logger
 
     os.makedirs(run_dir, exist_ok=True)
+    # the fleet black box: per-round records + metrics + trace persist
+    # under run_dir/obs/rank<base_rank>/ from here on (obs-report merges
+    # them across ranks — docs/observability.md)
+    _flight.configure(run_dir, rank=int(rank))
     ckpt_dir = os.path.join(run_dir, "checkpoints")
     member_dir = os.path.join(run_dir, "members")
     gen_path = os.path.join(run_dir, "generation.json")
@@ -409,6 +430,10 @@ def elastic_train(
         rank_g = members.index(base_rank)
         _trace.instant("elastic_generation", generation=gen,
                        world=world_g, rank=rank_g)
+        # stamp the generation on every round record from here on: the
+        # fleet table keys (gen, round), so replayed rounds after a
+        # resize land in their own entries instead of overwriting gen 0's
+        _flight.RECORDER.set_generation(gen)
         mesh = None
         if world_g > 1:
             from .parallel.mesh import init_distributed
@@ -441,6 +466,10 @@ def elastic_train(
             REGISTRY.counter(
                 "elastic_resume_rounds_replayed",
                 "Rounds re-trained after elastic resizes").inc(replayed)
+            _trace.instant("elastic_replay", generation=gen,
+                           resumed=resumed, replayed=replayed)
+            _flight.RECORDER.event("elastic_replay", generation=gen,
+                                   resumed=resumed, replayed=replayed)
 
         try:
             import contextlib
@@ -458,6 +487,11 @@ def elastic_train(
                     checkpoint_shared=True,
                 )
             membership.stop()
+            # elastic workers leave via elastic_exit (os._exit — no
+            # atexit): flush the black box and trace NOW or lose them
+            _flight.RECORDER.dump("elastic_complete")
+            if _trace.enabled():
+                _trace.flush()
             return bst
         except BaseException as e:
             # NOTE: the heartbeat agent keeps beating through this whole
@@ -500,6 +534,15 @@ def elastic_train(
                     "peer); exiting rather than split-braining the run")
                 raise WorkerLost([base_rank]) from e
             _policy.record_failure("elastic_resize", e)
+            # QUIESCE committed its rounds in train()'s abort handler;
+            # mark the transition on both the trace and the flight stream
+            # (detection -> quiesce -> resize -> replay, obs-report's
+            # instant sequence)
+            _trace.instant("elastic_quiesce", generation=gen,
+                           at_round=at_round, dead=repr(dead))
+            _flight.RECORDER.event("elastic_quiesce", generation=gen,
+                                   at_round=at_round, dead=repr(dead))
+            _flight.RECORDER.dump("elastic_quiesce")
             for r in dead:
                 membership.declare_dead(r)
             survivors = [m for m in members if m not in dead]
@@ -528,6 +571,8 @@ def elastic_train(
                 "Training restarts caused by elastic resizes").inc()
             _trace.instant("elastic_resize", generation=gen,
                            dead=repr(dead), world=len(survivors))
+            _flight.RECORDER.event("elastic_resize", generation=gen,
+                                   dead=repr(dead), world=len(survivors))
             console_logger.warning(
                 f"elastic: lost rank(s) {dead}; resizing world "
                 f"{len(members)} -> {len(survivors)} (generation {gen}), "
@@ -547,6 +592,8 @@ def elastic_train(
             console_logger.warning(
                 f"elastic: re-executing worker for generation {gen} "
                 f"(world {len(survivors)})")
+            if _trace.enabled():  # execv skips atexit: flush the timeline
+                _trace.flush()
             sys.stdout.flush()
             sys.stderr.flush()
             os.execv(sys.executable, [sys.executable] + sys.argv)
