@@ -13,7 +13,10 @@ then (when the stage completes) ONE more line for the serving benchmark:
 batched inplace-predict throughput in rows/s, with vs_baseline = the
 inplace/DMatrix-path throughput ratio on the same batch (the serving
 speedup this line exists to measure; docs/serving.md). A small-batch
-latency sweep (1/16/256/4096 rows) goes to stderr + the partial sidecar.
+latency sweep (1/16/256/4096 rows) and a concurrent-serving stage (K
+client threads of ragged batches through the model server's micro-batcher
+vs the same stream sequential: ``predict_served_rows_per_s`` with the
+coalescing ratio) go to stderr + the partial sidecar.
 
 Two configurations are measured:
 - reference-default (max_bin=256): apples-to-apples with the reference's
@@ -440,6 +443,13 @@ def _predict_bench(xgb, X, y, args, suffix: str, final_predict: dict) -> None:
         print(f"# inplace latency {bs} rows: {latency[bs]:.2f} ms",
               file=sys.stderr, flush=True)
 
+    try:
+        _served_bench(bst, Xs)
+    except Exception as e:  # noqa: BLE001 — the server stage must never
+        # cost the primary predict metric
+        print(f"# served bench failed ({type(e).__name__}: {e}); skipping",
+              file=sys.stderr, flush=True)
+
     name = (f"predict_inplace_{rows // 1000}kx{args.columns}_"
             f"{bst.num_boosted_rounds()}r{suffix}")
     ratio = round(rps_i / max(rps_d, 1e-9), 3)
@@ -460,6 +470,85 @@ def _predict_bench(xgb, X, y, args, suffix: str, final_predict: dict) -> None:
                   "parity": parity,
                   "latency_ms": {str(k): round(v, 3)
                                  for k, v in latency.items()}})
+
+
+def _served_bench(bst, Xs: np.ndarray, n_threads: int = 8,
+                  n_requests: int = 400) -> None:
+    """Concurrent-serving stage (ISSUE 8 satellite): the same stream of
+    ragged small batches served two ways — sequentially through
+    ``inplace_predict`` (the naive loop) and concurrently through the
+    model server's micro-batcher from ``n_threads`` client threads. Emits
+    ``predict_served_rows_per_s`` to stderr + the partial sidecar with
+    the coalescing ratio (requests per compiled-program dispatch)."""
+    import threading
+
+    from xgboost_tpu.observability import REGISTRY
+    from xgboost_tpu.serving import ModelServer
+
+    def counter(name):
+        fam = REGISTRY.get(name)
+        return 0.0 if fam is None else fam.labels().value
+
+    rng = np.random.RandomState(11)
+    reqs = [(int(lo), int(n)) for lo, n in zip(
+        rng.randint(0, max(1, Xs.shape[0] - 64), n_requests),
+        rng.randint(1, 65, n_requests))]
+    total_rows = sum(n for _, n in reqs)
+
+    # sequential baseline: one caller, one dispatch per request
+    bst.inplace_predict(Xs[:16])  # warm
+    t0 = time.perf_counter()
+    for lo, n in reqs:
+        bst.inplace_predict(Xs[lo:lo + n])
+    seq_s = time.perf_counter() - t0
+
+    srv = ModelServer(batch_wait_us=500)
+    try:
+        srv.load("bench", bst)
+        srv.predict("bench", Xs[:16])  # warm the served path too
+        d0 = counter("serving_dispatches_total")
+        b0 = counter("serving_requests_batched_total")
+        shards = [reqs[k::n_threads] for k in range(n_threads)]
+        errors = []
+
+        def client(shard):
+            try:
+                for lo, n in shard:
+                    srv.predict("bench", Xs[lo:lo + n], timeout=120)
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                errors.append(repr(e))
+
+        threads = [threading.Thread(target=client, args=(s,))
+                   for s in shards]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        served_s = time.perf_counter() - t0
+        if errors:
+            raise RuntimeError(f"{len(errors)} served requests failed: "
+                               f"{errors[0]}")
+        dispatches = counter("serving_dispatches_total") - d0
+        batched = counter("serving_requests_batched_total") - b0
+        coalesce = batched / max(dispatches, 1.0)
+    finally:
+        srv.close()
+    served_rps = total_rows / max(served_s, 1e-9)
+    seq_rps = total_rows / max(seq_s, 1e-9)
+    print(f"# predict_served_rows_per_s={served_rps:,.0f} "
+          f"(sequential {seq_rps:,.0f} rows/s, {n_threads} threads, "
+          f"{n_requests} ragged reqs, coalescing {coalesce:.1f} req/dispatch"
+          f" over {dispatches:.0f} dispatches)",
+          file=sys.stderr, flush=True)
+    _log_partial({"config": "predict_served",
+                  "metric": "predict_served_rows_per_s",
+                  "value": round(served_rps, 1),
+                  "sequential_rows_per_s": round(seq_rps, 1),
+                  "threads": n_threads, "requests": n_requests,
+                  "rows": total_rows,
+                  "coalesce_ratio": round(coalesce, 2),
+                  "dispatches": int(dispatches)})
 
 
 def _report_arithmetic_intensity() -> None:

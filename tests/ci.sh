@@ -1,7 +1,7 @@
 #!/bin/bash
 # CI entry point (reference analog: Jenkinsfile / .github workflows +
-# sanitizer builds, CMakeLists.txt:61-64). Tiers (0-4 plus the chaos
-# lane between 1 and 2):
+# sanitizer builds, CMakeLists.txt:61-64). Tiers (0-4 plus the chaos,
+# elastic and serving lanes between 1 and 2):
 #   0. static-analysis gate: `python -m xgboost_tpu lint` must exit 0 —
 #      any unsuppressed trace-safety / retrace / dtype / concurrency
 #      finding (docs/static_analysis.md) fails CI before a single test
@@ -221,6 +221,92 @@ assert any(rec.get("t") == "round" for rec in parsed), \
     "SIGKILLed rank committed no round records before dying"
 print(f"obs-report OK: {len(merged)} merged events, ranks {sorted(pids)}, "
       "membership instants + elastic rollup + SIGKILL black box present")
+EOF
+
+echo "=== tier 1.7: serving smoke lane (model server CLI) ==="
+# The production model server end to end, the way an operator runs it:
+# start `python -m xgboost_tpu serve` on a TCP port with a v1 model,
+# drive concurrent client connections (so the micro-batcher actually
+# coalesces), hot-swap to v2 MID-TRAFFIC, and require zero failed
+# requests plus the serving metrics (model_swaps_total,
+# requests_shed_total) in the exposition (docs/serving.md).
+python - <<'EOF'
+import json, os, socket, subprocess, sys, tempfile, threading, time
+
+import numpy as np
+
+import xgboost_tpu as xgb
+
+rng = np.random.RandomState(0)
+X = rng.randn(400, 5).astype(np.float32)
+y = (X[:, 0] > 0).astype(np.float32)
+params = {"objective": "binary:logistic", "max_depth": 3, "max_bin": 16,
+          "verbosity": 0}
+tmp = tempfile.mkdtemp(prefix="ci_serving_")
+v1 = xgb.train(params, xgb.DMatrix(X, label=y), 3)
+v1_path = os.path.join(tmp, "v1.json"); v1.save_model(v1_path)
+v2 = xgb.train(dict(params, seed=5), xgb.DMatrix(X, label=y), 4)
+v2_path = os.path.join(tmp, "v2.json"); v2.save_model(v2_path)
+
+s = socket.socket(); s.bind(("127.0.0.1", 0))
+port = s.getsockname()[1]; s.close()
+env = dict(os.environ)
+env["PYTHONPATH"] = os.getcwd() + os.pathsep + env.get("PYTHONPATH", "")
+proc = subprocess.Popen(
+    [sys.executable, "-m", "xgboost_tpu", "serve", "--port", str(port),
+     "--model", f"m={v1_path}", "--batch-wait-us", "2000"],
+    env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+try:
+    ready = proc.stdout.readline()
+    assert ready.startswith("READY"), ready
+
+    def rpc(sock, obj):
+        sock.sendall((json.dumps(obj) + "\n").encode())
+        buf = b""
+        while not buf.endswith(b"\n"):
+            chunk = sock.recv(1 << 16)
+            assert chunk, "server closed connection mid-response"
+            buf += chunk
+        return json.loads(buf)
+
+    failures, served = [], [0]
+    def traffic(k):
+        c = socket.create_connection(("127.0.0.1", port), timeout=60)
+        try:
+            for i in range(25):
+                lo = (k * 37 + i * 7) % 350
+                r = rpc(c, {"op": "predict", "id": f"{k}-{i}", "model": "m",
+                            "data": X[lo:lo + 1 + (i % 4)].tolist()})
+                if "error" in r:
+                    failures.append(r)
+                else:
+                    served[0] += 1
+        finally:
+            c.close()
+
+    threads = [threading.Thread(target=traffic, args=(k,)) for k in range(4)]
+    for t in threads: t.start()
+    time.sleep(0.3)  # let traffic build, then swap under it
+    ctl = socket.create_connection(("127.0.0.1", port), timeout=60)
+    r = rpc(ctl, {"op": "swap", "model": "m", "path": v2_path})
+    assert r.get("version") == "m@v2", r
+    for t in threads: t.join()
+    assert not failures, f"requests failed across the hot swap: {failures[:3]}"
+    exp = rpc(ctl, {"op": "metrics"})["metrics"]
+    assert 'model_swaps_total{model="m@v2"} 1' in exp, exp[-2000:]
+    assert "requests_shed_total" in exp, exp[-2000:]
+    assert "serving_dispatches_total" in exp
+    # post-swap traffic is v2: full-batch check against the real model
+    post = rpc(ctl, {"op": "predict", "model": "m", "data": X[:8].tolist()})
+    ref = np.asarray(v2.inplace_predict(X[:8]), np.float64)
+    assert np.allclose(post["result"], ref, atol=1e-6)
+    rpc(ctl, {"op": "shutdown"}); ctl.close()
+    proc.wait(timeout=60)
+    print(f"serving smoke OK: {served[0]} requests, 0 failures, "
+          "hot swap mid-traffic, metrics exported")
+finally:
+    if proc.poll() is None:
+        proc.kill()
 EOF
 
 echo "=== tier 2: trace parses as Chrome trace JSON ==="

@@ -192,6 +192,11 @@ extern int XGBoosterFree(void*);
 extern int XGBoosterSaveJsonConfig(void*, bst_ulong*, const char**);
 extern int XGBoosterSerializeToBuffer(void*, bst_ulong*, const char**);
 extern int XGBoosterUnserializeFromBuffer(void*, const void*, bst_ulong);
+extern int XGDMatrixSliceDMatrix(void*, const int*, bst_ulong, void**);
+extern int XGBoosterSetStrFeatureInfo(void*, const char*, const char**,
+                                      bst_ulong);
+extern int XGBoosterGetStrFeatureInfo(void*, const char*, bst_ulong*,
+                                      const char***);
 
 #define CK(x) if ((x) != 0) { \
   fprintf(stderr, "FAIL: %s\n", XGBGetLastError()); return 1; }
@@ -254,6 +259,44 @@ int main(void) {
     }
   }
   printf("C_HOST_SERIALIZE=OK\n");
+
+  /* serving-adjacent breadth (ISSUE 8 satellite): row slicing and model
+     feature metadata, both exercised from a real C host */
+  int idx[64];
+  for (int i = 0; i < 64; ++i) idx[i] = i * 2;
+  /* predicting again through `bst` reuses its out-buffer: snapshot the
+     full-matrix predictions before the slice predict overwrites them */
+  static float full[N];
+  memcpy(full, out, sizeof(float) * N);
+  void *dslice = NULL;
+  CK(XGDMatrixSliceDMatrix(dmat, idx, 64, &dslice));
+  bst_ulong slen = 0;
+  const float *sout = NULL;
+  CK(XGBoosterPredict(bst, dslice, 0, 0, 0, &slen, &sout));
+  if (slen != 64) { fprintf(stderr, "bad slice len\n"); return 1; }
+  for (int i = 0; i < 64; ++i) {
+    if (sout[i] != full[idx[i]]) {
+      fprintf(stderr, "slice predict mismatch at %d\n", i);
+      return 1;
+    }
+  }
+  printf("C_HOST_SLICE=OK\n");
+
+  const char *names[F] = {"alpha", "beta", "gamma"};
+  CK(XGBoosterSetStrFeatureInfo(bst, "feature_name", names, F));
+  bst_ulong nlen = 0;
+  const char **got_names = NULL;
+  CK(XGBoosterGetStrFeatureInfo(bst, "feature_name", &nlen, &got_names));
+  if (nlen != F) { fprintf(stderr, "bad feature_name len\n"); return 1; }
+  for (int j = 0; j < F; ++j) {
+    if (strcmp(got_names[j], names[j]) != 0) {
+      fprintf(stderr, "feature_name mismatch at %d: %s\n", j, got_names[j]);
+      return 1;
+    }
+  }
+  printf("C_HOST_FEATINFO=OK\n");
+
+  CK(XGDMatrixFree(dslice));
   CK(XGBoosterFree(bst2));
   CK(XGBoosterFree(bst));
   CK(XGDMatrixFree(dmat));
@@ -286,6 +329,9 @@ def test_c_api_from_real_c_host(lib, tmp_path):
     assert acc > 0.9, out.stdout
     # the serialize/config surface ran and round-tripped bit-for-bit
     assert "C_HOST_SERIALIZE=OK" in out.stdout, out.stdout
+    # slicing + model feature metadata from the C host (ISSUE 8 satellite)
+    assert "C_HOST_SLICE=OK" in out.stdout, out.stdout
+    assert "C_HOST_FEATINFO=OK" in out.stdout, out.stdout
 
 
 def test_c_api_csr_dump_and_buffer_roundtrip(lib, tmp_path):
@@ -651,6 +697,152 @@ def test_c_api_inplace_predict_dense_and_csr(lib):
         ctypes.byref(shp), ctypes.byref(dim), ctypes.byref(res))
     assert rc == -1 and lib.XGBGetLastError()
     _check(lib, lib.XGBoosterFree(bh))
+
+
+def test_c_api_slice_dmatrix(lib):
+    """XGDMatrixSliceDMatrix (ISSUE 8 satellite; reference c_api.h:240):
+    the sliced handle carries the selected rows AND their metadata, and
+    predictions on it match numpy-indexing the full matrix's output."""
+    X, y = _data(300, 4, seed=17)
+    n, F = X.shape
+    h = ctypes.c_void_p()
+    Xf = np.ascontiguousarray(X)
+    _check(lib, lib.XGDMatrixCreateFromMat(
+        Xf.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), n, F,
+        ctypes.c_float(float("nan")), ctypes.byref(h)))
+    yl = np.ascontiguousarray(y)
+    _check(lib, lib.XGDMatrixSetFloatInfo(
+        h, b"label", yl.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), n))
+
+    idx = np.ascontiguousarray(np.arange(1, n, 3, dtype=np.int32))
+    h2 = ctypes.c_void_p()
+    _check(lib, lib.XGDMatrixSliceDMatrix(
+        h, idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int)), len(idx),
+        ctypes.byref(h2)))
+    out = ctypes.c_uint64()
+    _check(lib, lib.XGDMatrixNumRow(h2, ctypes.byref(out)))
+    assert out.value == len(idx)
+    _check(lib, lib.XGDMatrixNumCol(h2, ctypes.byref(out)))
+    assert out.value == F
+
+    # per-row metadata sliced along
+    flen = ctypes.c_uint64()
+    fptr = ctypes.POINTER(ctypes.c_float)()
+    _check(lib, lib.XGDMatrixGetFloatInfo(h2, b"label", ctypes.byref(flen),
+                                          ctypes.byref(fptr)))
+    got = np.ctypeslib.as_array(fptr, shape=(flen.value,)).copy()
+    np.testing.assert_array_equal(got, y[idx])
+
+    # margin predictions on the slice == numpy-indexed full predictions
+    bh = ctypes.c_void_p()
+    mats = (ctypes.c_void_p * 1)(h)
+    _check(lib, lib.XGBoosterCreate(mats, 1, ctypes.byref(bh)))
+    for k, v in [(b"objective", b"binary:logistic"), (b"max_depth", b"3"),
+                 (b"max_bin", b"16"), (b"seed", b"3"), (b"verbosity", b"0")]:
+        _check(lib, lib.XGBoosterSetParam(bh, k, v))
+    for it in range(3):
+        _check(lib, lib.XGBoosterUpdateOneIter(bh, it, h))
+    plen = ctypes.c_uint64()
+    pptr = ctypes.POINTER(ctypes.c_float)()
+    _check(lib, lib.XGBoosterPredict(bh, h, 1, 0, 0, ctypes.byref(plen),
+                                     ctypes.byref(pptr)))
+    full = np.ctypeslib.as_array(pptr, shape=(plen.value,)).copy()
+    _check(lib, lib.XGBoosterPredict(bh, h2, 1, 0, 0, ctypes.byref(plen),
+                                     ctypes.byref(pptr)))
+    sliced = np.ctypeslib.as_array(pptr, shape=(plen.value,)).copy()
+    np.testing.assert_array_equal(sliced, full[idx])
+    _check(lib, lib.XGBoosterFree(bh))
+    _check(lib, lib.XGDMatrixFree(h2))
+    _check(lib, lib.XGDMatrixFree(h))
+
+
+def test_c_api_str_feature_info_roundtrip(lib):
+    """XGBoosterSetStrFeatureInfo/GetStrFeatureInfo (ISSUE 8 satellite;
+    reference c_api.h:1146): names/types attach to the MODEL, round-trip
+    through the C surface, and survive a save/load-from-buffer cycle."""
+    X, y = _data(200, 3, seed=23)
+    d = xgb.DMatrix(X, label=y)
+    bst = xgb.train({"objective": "binary:logistic", "max_depth": 2,
+                     "max_bin": 16, "verbosity": 0}, d, 2)
+    blob = bst.save_raw()
+    bh = ctypes.c_void_p()
+    _check(lib, lib.XGBoosterCreate(None, 0, ctypes.byref(bh)))
+    _check(lib, lib.XGBoosterLoadModelFromBuffer(bh, blob, len(blob)))
+
+    lib.XGBoosterGetStrFeatureInfo.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_char_p))]
+    names = [b"age", b"bmi", b"dose"]
+    arr = (ctypes.c_char_p * len(names))(*names)
+    _check(lib, lib.XGBoosterSetStrFeatureInfo(
+        bh, b"feature_name", arr, len(names)))
+    types = [b"float", b"float", b"int"]
+    tarr = (ctypes.c_char_p * len(types))(*types)
+    _check(lib, lib.XGBoosterSetStrFeatureInfo(
+        bh, b"feature_type", tarr, len(types)))
+
+    olen = ctypes.c_uint64()
+    optr = ctypes.POINTER(ctypes.c_char_p)()
+    _check(lib, lib.XGBoosterGetStrFeatureInfo(
+        bh, b"feature_name", ctypes.byref(olen), ctypes.byref(optr)))
+    assert [optr[i] for i in range(olen.value)] == names
+    _check(lib, lib.XGBoosterGetStrFeatureInfo(
+        bh, b"feature_type", ctypes.byref(olen), ctypes.byref(optr)))
+    assert [optr[i] for i in range(olen.value)] == types
+
+    # the info is model state: it survives a buffer round-trip
+    blen = ctypes.c_uint64()
+    bptr = ctypes.c_char_p()
+    _check(lib, lib.XGBoosterSaveModelToBuffer(
+        bh, b"{}", ctypes.byref(blen), ctypes.byref(bptr)))
+    raw = ctypes.string_at(bptr, blen.value)
+    bh2 = ctypes.c_void_p()
+    _check(lib, lib.XGBoosterCreate(None, 0, ctypes.byref(bh2)))
+    _check(lib, lib.XGBoosterLoadModelFromBuffer(bh2, raw, len(raw)))
+    _check(lib, lib.XGBoosterGetStrFeatureInfo(
+        bh2, b"feature_name", ctypes.byref(olen), ctypes.byref(optr)))
+    assert [optr[i] for i in range(olen.value)] == names
+
+    # clearing with size 0 empties the surface; bad fields fail loudly
+    _check(lib, lib.XGBoosterSetStrFeatureInfo(bh, b"feature_name", None, 0))
+    _check(lib, lib.XGBoosterGetStrFeatureInfo(
+        bh, b"feature_name", ctypes.byref(olen), ctypes.byref(optr)))
+    assert olen.value == 0
+    rc = lib.XGBoosterSetStrFeatureInfo(bh, b"no_such_field", arr, 1)
+    assert rc == -1 and lib.XGBGetLastError()
+    _check(lib, lib.XGBoosterFree(bh))
+    _check(lib, lib.XGBoosterFree(bh2))
+
+
+def test_dmatrix_slice_python_semantics():
+    """The Python side of XGDMatrixSliceDMatrix: bool masks, sparse stays
+    sparse, and group structure refuses without allow_groups."""
+    import scipy.sparse as sp
+
+    X, y = _data(120, 4, seed=29)
+    d = xgb.DMatrix(X, label=y, weight=np.arange(120, dtype=np.float32))
+    mask = X[:, 0] > 0
+    s = d.slice(mask)
+    assert s.num_row() == int(mask.sum())
+    np.testing.assert_array_equal(s.get_label(), y[mask])
+    np.testing.assert_array_equal(
+        s.get_weight(), np.arange(120, dtype=np.float32)[mask])
+
+    Xs = sp.random(80, 5, density=0.4, format="csr", random_state=1,
+                   dtype=np.float32)
+    ds = xgb.DMatrix(Xs)
+    ss = ds.slice(np.arange(0, 80, 2))
+    assert ss._sparse is not None, "sparse slice densified"
+    np.testing.assert_array_equal(
+        np.asarray(ss.get_data().todense()),
+        np.asarray(Xs[::2].todense()))
+
+    dg = xgb.DMatrix(X, label=y, group=[60, 60])
+    with pytest.raises(ValueError, match="group"):
+        dg.slice(np.arange(10))
+    assert dg.slice(np.arange(10), allow_groups=True).num_row() == 10
+    with pytest.raises(IndexError):
+        d.slice(np.asarray([200]))
 
 
 def test_c_api_predict_ntree_limit_counts_trees(lib):

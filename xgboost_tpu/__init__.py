@@ -69,6 +69,8 @@ __all__ = [
     "config_context",
     "set_config",
     "get_config",
+    "ModelServer",
+    "RequestShed",
     "__version__",
 ]
 
@@ -86,4 +88,13 @@ def __getattr__(name):
         from . import sklearn as _sk
 
         return getattr(_sk, name)
+    # serving front end (docs/serving.md "The model server"): soft import
+    # so `import xgboost_tpu` doesn't pay for the server machinery.
+    # import_module, not `from . import`: the latter re-enters this
+    # __getattr__ while the submodule attribute is still unset
+    if name in ("ModelServer", "RequestShed", "serving"):
+        import importlib
+
+        _serving = importlib.import_module(".serving", __name__)
+        return _serving if name == "serving" else getattr(_serving, name)
     raise AttributeError(f"module 'xgboost_tpu' has no attribute '{name}'")

@@ -7,6 +7,7 @@ key=value config parser (``src/common/config.h``). Usage:
     python -m xgboost_tpu trace-report <trace-file|glob> ... [--top N]
     python -m xgboost_tpu obs-report <run_dir> [--top-rounds N]
     python -m xgboost_tpu checkpoint-inspect <dir>
+    python -m xgboost_tpu serve (--port N | --stdin) [--model name=path ...]
 
 Config keys mirror the reference: task, data, test:data, model_in,
 model_out, model_dir, num_round, save_period, eval[name]=path, dump_format,
@@ -97,6 +98,10 @@ def cli_main(argv: List[str]) -> int:
         return lint_main(argv[1:])
     if argv[0] == "checkpoint-inspect":
         return checkpoint_inspect_main(argv[1:])
+    if argv[0] == "serve":
+        from .serving.server import serve_main
+
+        return serve_main(argv[1:])
     pairs = parse_config_file(argv[0])
     for extra in argv[1:]:
         k, _, v = extra.partition("=")

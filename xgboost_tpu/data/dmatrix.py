@@ -230,11 +230,9 @@ class DMatrix:
         import scipy.sparse as sp
 
         if self._sparse is not None and self._data is None:
-            st = self._sparse
-            return sp.csr_matrix(
-                (np.asarray(st.values), np.asarray(st.indices),
-                 np.asarray(st.indptr)),
-                shape=(self.num_row(), self.num_col()))
+            # pre-serving bug: this read .values/.indices/.indptr, which
+            # CSRStorage never had — it wraps one scipy CSR (.csr)
+            return sp.csr_matrix(self._sparse.csr, copy=True)
         X = np.asarray(self.data)
         mask = ~np.isnan(X)
         return sp.csr_matrix(np.where(mask, X, 0.0) * mask)
@@ -492,8 +490,29 @@ class DMatrix:
                     f"exceeding max_bin={max_bin}; raise max_bin"
                 )
 
-    def slice(self, rindex: Any) -> "DMatrix":
+    def slice(self, rindex: Any, allow_groups: bool = False) -> "DMatrix":
+        """A new DMatrix holding the selected rows, with per-row metadata
+        (label/weight/base_margin/survival bounds) and feature metadata
+        sliced along (reference: ``core.py DMatrix.slice`` /
+        ``XGDMatrixSliceDMatrix``). ``rindex`` is an integer index array
+        or a boolean row mask; out-of-range indices raise. Ranking group
+        structure does not survive arbitrary row slicing — matrices with
+        groups refuse unless ``allow_groups=True`` drops it (the
+        reference's ``XGDMatrixSliceDMatrixEx`` contract). Sparse-
+        constructed matrices stay sparse: no densification to slice."""
         rindex = np.asarray(rindex)
+        if rindex.dtype == np.bool_:
+            rindex = np.nonzero(rindex)[0]
+        rindex = rindex.astype(np.int64).ravel()
+        n = self.num_row()
+        if rindex.size and (rindex.min() < -n or rindex.max() >= n):
+            raise IndexError(
+                f"slice index out of range for {n} rows: "
+                f"[{rindex.min()}, {rindex.max()}]")
+        if self.info.group_ptr is not None and not allow_groups:
+            raise ValueError(
+                "slice does not support group structure; pass "
+                "allow_groups=True to drop it")
         out = DMatrix.__new__(DMatrix)
         if self._sparse is not None and self._data is None:
             out._sparse = self._sparse.slice_rows(rindex)

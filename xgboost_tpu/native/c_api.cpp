@@ -21,6 +21,8 @@
 //   XGBoosterSerializeToBuffer/UnserializeFromBuffer  c_api.h:1030 (model
 //     + learner configuration — the full-state pair Save/LoadModel drops)
 //   XGBoosterSaveJsonConfig/LoadJsonConfig            c_api.h:990
+//   XGDMatrixSliceDMatrix                             c_api.h:240
+//   XGBoosterSetStrFeatureInfo/GetStrFeatureInfo      c_api.h:1146,1182
 //   XGBoosterSetAttr/GetAttr, XGBVersion
 // Error contract matches the reference: every call returns 0 on success,
 // -1 on failure with the message retrievable via XGBGetLastError().
@@ -133,6 +135,8 @@ struct BoosterWrap {
   std::vector<bst_ulong> pred_shape;  // PredictFromDMatrix out-shape
   std::vector<std::string> dump;      // XGBoosterDumpModel storage
   std::vector<const char *> dump_ptrs;
+  std::vector<std::string> feat_info;  // GetStrFeatureInfo storage
+  std::vector<const char *> feat_ptrs;
 };
 
 
@@ -367,6 +371,32 @@ XGB_DLL int XGDMatrixNumCol(DMatrixHandle handle, bst_ulong *out) {
   if (r == nullptr) return fail();
   *out = static_cast<bst_ulong>(PyLong_AsUnsignedLongLong(r));
   Py_DECREF(r);
+  return 0;
+}
+
+XGB_DLL int XGDMatrixSliceDMatrix(DMatrixHandle handle, const int *idxset,
+                                  bst_ulong len, DMatrixHandle *out) {
+  // reference c_api.h:240: a new DMatrix holding the selected rows with
+  // per-row metadata sliced along (serving-side train/validate splits
+  // without re-ingesting the data)
+  Gil gil;
+  auto *w = static_cast<MatWrap *>(handle);
+  PyObject *np = imp("numpy");
+  if (np == nullptr) return fail();
+  PyObject *mv = PyMemoryView_FromMemory(
+      reinterpret_cast<char *>(const_cast<int *>(idxset)),
+      static_cast<Py_ssize_t>(len * sizeof(int)), PyBUF_READ);
+  if (mv == nullptr) return fail();
+  PyObject *raw = PyObject_CallMethod(np, "frombuffer", "Os", mv, "int32");
+  Py_DECREF(mv);
+  if (raw == nullptr) return fail();
+  PyObject *idx = PyObject_CallMethod(raw, "astype", "s", "int64");  // copy
+  Py_DECREF(raw);
+  if (idx == nullptr) return fail();
+  PyObject *d = PyObject_CallMethod(w->obj, "slice", "O", idx);
+  Py_DECREF(idx);
+  if (d == nullptr) return fail();
+  *out = new MatWrap(d);
   return 0;
 }
 
@@ -657,6 +687,94 @@ XGB_DLL int XGBoosterGetAttr(BoosterHandle handle, const char *key,
     *success = 1;
   }
   Py_DECREF(r);
+  return 0;
+}
+
+namespace {
+
+// "feature_name" / "feature_type" (reference c_api.h field grammar) ->
+// the Booster property carrying it; nullptr for anything else
+const char *feat_attr_for(const char *field) {
+  if (field != nullptr && std::strcmp(field, "feature_name") == 0)
+    return "feature_names";
+  if (field != nullptr && std::strcmp(field, "feature_type") == 0)
+    return "feature_types";
+  return nullptr;
+}
+
+}  // namespace
+
+XGB_DLL int XGBoosterSetStrFeatureInfo(BoosterHandle handle,
+                                       const char *field,
+                                       const char **features,
+                                       bst_ulong size) {
+  // reference c_api.h:1146: attach feature names/types to the MODEL (not
+  // a DMatrix), so they survive save/load and drive dump output
+  Gil gil;
+  auto *w = static_cast<BoosterWrap *>(handle);
+  const char *attr = feat_attr_for(field);
+  if (attr == nullptr)
+    return fail_msg(
+        "XGBoosterSetStrFeatureInfo: field must be 'feature_name' or "
+        "'feature_type'");
+  PyObject *value = nullptr;
+  if (size == 0) {
+    value = Py_None;
+    Py_INCREF(value);
+  } else {
+    value = PyList_New(static_cast<Py_ssize_t>(size));
+    if (value == nullptr) return fail();
+    for (bst_ulong i = 0; i < size; ++i) {
+      PyObject *s = PyUnicode_FromString(
+          features[i] == nullptr ? "" : features[i]);
+      if (s == nullptr) {
+        Py_DECREF(value);
+        return fail();
+      }
+      PyList_SET_ITEM(value, static_cast<Py_ssize_t>(i), s);  // steals s
+    }
+  }
+  int rc = PyObject_SetAttrString(w->obj, attr, value);
+  Py_DECREF(value);
+  return rc == 0 ? 0 : fail();
+}
+
+XGB_DLL int XGBoosterGetStrFeatureInfo(BoosterHandle handle,
+                                       const char *field, bst_ulong *len,
+                                       const char ***out_features) {
+  Gil gil;
+  auto *w = static_cast<BoosterWrap *>(handle);
+  const char *attr = feat_attr_for(field);
+  if (attr == nullptr)
+    return fail_msg(
+        "XGBoosterGetStrFeatureInfo: field must be 'feature_name' or "
+        "'feature_type'");
+  PyObject *r = PyObject_GetAttrString(w->obj, attr);
+  if (r == nullptr) return fail();
+  w->feat_info.clear();
+  w->feat_ptrs.clear();
+  if (r != Py_None) {
+    Py_ssize_t n = PySequence_Size(r);
+    if (n < 0) {
+      Py_DECREF(r);
+      return fail();
+    }
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject *it = PySequence_GetItem(r, i);
+      const char *c = it != nullptr ? PyUnicode_AsUTF8(it) : nullptr;
+      if (c == nullptr) {
+        Py_XDECREF(it);
+        Py_DECREF(r);
+        return fail();
+      }
+      w->feat_info.emplace_back(c);
+      Py_DECREF(it);
+    }
+  }
+  Py_DECREF(r);
+  for (auto &st : w->feat_info) w->feat_ptrs.push_back(st.c_str());
+  *len = static_cast<bst_ulong>(w->feat_info.size());
+  *out_features = w->feat_ptrs.data();
   return 0;
 }
 

@@ -242,9 +242,40 @@ def rollup_metrics(ranks: List[RankObs]) -> Dict[str, Any]:
         for _, agg in sorted(fam["series"].items()):
             if "buckets" in agg:
                 agg["buckets"] = dict(agg["buckets"])
+                # per-rank p50/p99 don't merge; recompute from the summed
+                # cumulative buckets so every labelled series (e.g. the
+                # per-model predict_latency_seconds children the serving
+                # layer writes) keeps fleet-wide quantiles
+                agg["p50"] = _merged_quantile(agg["buckets"],
+                                              agg["count"], 0.50)
+                agg["p99"] = _merged_quantile(agg["buckets"],
+                                              agg["count"], 0.99)
             series.append(agg)
         fam["series"] = series
     return out
+
+
+def _merged_quantile(buckets: Dict[str, Any], count: int,
+                     q: float) -> Optional[float]:
+    """Prometheus-style quantile from summed CUMULATIVE bucket counts
+    (``metrics.Histogram.quantile`` semantics; snapshot buckets are
+    cumulative and exclude +Inf, so ranks above the top bound clamp to
+    the largest finite bound). None on empty/unparsable series."""
+    if not count or not buckets:
+        return None
+    try:
+        ladder = sorted((float(ub), int(c)) for ub, c in buckets.items())
+    except (TypeError, ValueError):
+        return None
+    target = max(min(float(q), 1.0), 0.0) * count
+    lo, prev_cum = 0.0, 0
+    for ub, cum in ladder:
+        c = cum - prev_cum
+        if c and cum >= target:
+            frac = (target - prev_cum) / c
+            return lo + (ub - lo) * min(max(frac, 0.0), 1.0)
+        prev_cum, lo = cum, ub
+    return ladder[-1][0]
 
 
 # ---------------------------------------------------------------------------
@@ -357,9 +388,11 @@ def format_fleet_report(ranks: List[RankObs], rollup: Dict[str, Any],
             continue
         for s in fam["series"]:
             if s["count"]:
+                p99 = s.get("p99")
                 lines.append(
                     f"  {name}{_fmt_labels(s['labels'])}: count={s['count']} "
-                    f"mean={s['sum'] / s['count'] * 1e3:.3f}ms")
+                    f"mean={s['sum'] / s['count'] * 1e3:.3f}ms"
+                    + (f" p99={p99 * 1e3:.3f}ms" if p99 is not None else ""))
     return "\n".join(lines)
 
 

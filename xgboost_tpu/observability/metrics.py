@@ -230,6 +230,20 @@ class MetricsRegistry:
     def get(self, name: str) -> Optional[MetricFamily]:
         return self._families.get(name)
 
+    def quantile(self, name: str, q: float, **labels: Any
+                 ) -> Optional[float]:
+        """Estimated q-quantile of a histogram series, or None when the
+        family is absent, not a histogram, or the labelled child has no
+        observations — the one-call read the serving admission controller
+        uses for its p99-based shed estimate (``docs/serving.md``)."""
+        fam = self._families.get(name)
+        if fam is None or fam.kind != "histogram":
+            return None
+        key = tuple(sorted((k, str(v)) for k, v in labels.items()))
+        with fam._lock:
+            child = fam._children.get(key)
+        return None if child is None else child.quantile(q)
+
     def reset(self) -> None:
         """Drop every family (tests / between BENCH repetitions)."""
         with self._lock:

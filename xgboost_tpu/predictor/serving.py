@@ -36,12 +36,13 @@ Inference Library (padded SoA trees, fixed-shape kernels).
 
 from __future__ import annotations
 
+import contextlib
 import functools
 import os
 import threading
 import time
 from collections import OrderedDict
-from typing import Callable, Optional, Tuple
+from typing import Callable, Iterator, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -51,7 +52,8 @@ from ..analysis.retrace import guard_jit, note_retrace
 from ..observability import REGISTRY as _REGISTRY
 from . import StackedForest, _predict_margin_impl, predict_margin
 
-__all__ = ["bucket_rows", "ServingCache", "SERVING_CACHE", "predict_serving"]
+__all__ = ["bucket_rows", "ServingCache", "SERVING_CACHE", "predict_serving",
+           "serving_context"]
 
 _POW2_CAP = 8192  # largest power-of-two bucket
 _BIG_STEP = 8192  # above the cap: round up to a multiple of this
@@ -270,12 +272,56 @@ def _device_tree_weights(forest: StackedForest, tree_weights) -> jax.Array:
     return tw
 
 
+#: per-thread serving context set by the model server's dispatch loop
+#: (serving/batcher.py): carries the tenant label for per-model latency
+#: series and the admission layer's routing verdict. Thread-local by
+#: construction — each batcher worker labels only its own dispatches.
+_SERVING_TLS = threading.local()
+
+
+@contextlib.contextmanager
+def serving_context(model: str = "", force_native: bool = False
+                    ) -> Iterator[None]:
+    """Scope every ``predict_serving`` call on this thread to a tenant.
+
+    ``model`` labels the request's ``predict_latency_seconds`` sample
+    (``{model="name@vN"}``) so a multi-tenant server's tail latency is
+    scrapeable per model. ``force_native=True`` is the admission layer's
+    degrade route: the request walks the native CPU SoA forest even on a
+    device backend (the device path is DEGRADED — see
+    ``serving/admission.py`` / docs/resilience.md). Contexts nest; the
+    innermost wins."""
+    prev = (getattr(_SERVING_TLS, "model", ""),
+            getattr(_SERVING_TLS, "force_native", False))
+    _SERVING_TLS.model = model
+    _SERVING_TLS.force_native = force_native
+    try:
+        yield
+    finally:
+        _SERVING_TLS.model, _SERVING_TLS.force_native = prev
+
+
+def _device_route_degraded() -> bool:
+    """True when the resilience layer marks the device predict path
+    unhealthy (any ``pallas_predict`` key DEGRADED/DISABLED): serving
+    sheds the device dispatch entirely and takes the native CPU walker,
+    trading throughput for not queueing behind a faulting device."""
+    from ..resilience import degrade
+
+    return degrade.worst("pallas_predict") != degrade.HEALTHY
+
+
 def _native_route_ok(forest: StackedForest) -> bool:
-    return (
-        not forest.has_cats
-        and jax.default_backend() == "cpu"
-        and os.environ.get("XGBTPU_NATIVE_SERVING", "1") != "0"
-    )
+    if forest.has_cats \
+            or os.environ.get("XGBTPU_NATIVE_SERVING", "1") == "0":
+        return False
+    if jax.default_backend() == "cpu":
+        return True
+    # device backend: only when the admission layer forced the native
+    # route or the device path is degraded (docs/serving.md "SLO-aware
+    # admission")
+    return (getattr(_SERVING_TLS, "force_native", False)
+            or _device_route_degraded())
 
 
 def _tree_weights_np(forest: StackedForest, tree_weights) -> np.ndarray:
@@ -398,10 +444,17 @@ def predict_serving(
     t0 = time.perf_counter()
     out = _predict_serving_impl(forest, X, base, tree_weights, transform,
                                 cache)
-    _REGISTRY.histogram(
+    fam = _REGISTRY.histogram(
         "predict_latency_seconds",
         "End-to-end serving predict latency per request",
-        buckets=_LATENCY_BUCKETS).observe(time.perf_counter() - t0)
+        buckets=_LATENCY_BUCKETS)
+    dt = time.perf_counter() - t0
+    # unlabelled child stays the process-wide series (admission's p99
+    # estimate reads it); a tenant label adds a per-model series beside it
+    fam.observe(dt)
+    model = getattr(_SERVING_TLS, "model", "")
+    if model:
+        fam.labels(model=model).observe(dt)
     return out
 
 

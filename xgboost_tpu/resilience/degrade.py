@@ -37,7 +37,7 @@ from . import policy
 __all__ = [
     "HEALTHY", "DEGRADED", "DISABLED", "STATE_NAMES",
     "CapabilityHealth", "OneShot", "capability", "capabilities",
-    "snapshot", "reset",
+    "snapshot", "worst", "reset",
 ]
 
 HEALTHY = 0
@@ -280,6 +280,17 @@ def capabilities() -> Dict[str, CapabilityHealth]:
 def snapshot() -> Dict[str, Any]:
     """JSON-able view of every capability (BENCH/MULTICHIP sidecars)."""
     return {name: cap.snapshot() for name, cap in capabilities().items()}
+
+
+def worst(name: str) -> int:
+    """Worst state across the named capability's keys — ``HEALTHY`` when
+    the capability was never registered. Read-only: unlike ``allowed()``
+    this burns no retry countdown, so routing layers (the serving
+    admission controller) can poll it per request without racing the
+    owner's own probe schedule."""
+    with _registry_lock:
+        cap = _capabilities.get(name)
+    return HEALTHY if cap is None else cap.worst_state()
 
 
 def reset() -> None:

@@ -1,0 +1,34 @@
+"""Production model server over the PR-2 serving fast path.
+
+The per-process primitives (``predictor/serving.py``: zero-copy inplace
+predict, bucketed compiled-program cache, native CPU SoA walker) serve ONE
+synchronous caller. This package is the traffic-facing layer on top —
+the serving-side analog of the reference's bindings/frontends tier
+(PAPER.md layer 8):
+
+- :mod:`~xgboost_tpu.serving.batcher` — async micro-batching: concurrent
+  small requests coalesce into one bucketed dispatch (the bucket padding
+  the fast path already pays gets filled with real traffic);
+- :mod:`~xgboost_tpu.serving.tenancy` — multi-model arena: N boosters
+  resident by ``name@version`` under an LRU memory budget;
+- :mod:`~xgboost_tpu.serving.swap` — zero-downtime hot swap: load → warm
+  → atomic pointer flip → drain the old snapshot;
+- :mod:`~xgboost_tpu.serving.admission` — SLO-aware admission: deadline /
+  queue-depth / p99 shed decisions, degrade-machine routing to the native
+  CPU walker.
+
+Entry points: :class:`ModelServer` (``xgb.ModelServer``) in Python,
+``python -m xgboost_tpu serve`` for the JSONL stdin/socket protocol.
+Full walkthrough: docs/serving.md ("The model server").
+"""
+
+from .admission import AdmissionController, RequestShed  # noqa: F401
+from .batcher import MicroBatcher  # noqa: F401
+from .server import ModelServer, serve_main  # noqa: F401
+from .swap import hot_swap  # noqa: F401
+from .tenancy import ModelEntry, ModelRegistry  # noqa: F401
+
+__all__ = [
+    "AdmissionController", "MicroBatcher", "ModelEntry", "ModelRegistry",
+    "ModelServer", "RequestShed", "hot_swap", "serve_main",
+]

@@ -1,0 +1,139 @@
+"""SLO-aware admission: shed early, degrade gracefully.
+
+A serving frontend that accepts every request fails them all at once when
+traffic exceeds capacity — queues grow without bound, every caller times
+out, and the process looks wedged (the reference's analog is rabit's "fail
+fast, recover from checkpoint" stance: bounded damage beats unbounded
+queues). This module is the decision layer in front of the micro-batcher:
+
+- **deadline** — every request may carry one (``deadline_ms``). A request
+  whose deadline already passed, or whose *estimated* completion time
+  (queue depth x the p99 of ``predict_latency_seconds``, read from the
+  process registry) overshoots it, is shed at submit time with a typed
+  :class:`RequestShed` instead of being served late. The batcher re-checks
+  at dispatch so a request that aged out while queued is shed, not walked.
+- **queue bound** — the request queue is bounded
+  (``XGBTPU_SERVING_QUEUE``, default 1024); overflow sheds with reason
+  ``queue_full`` rather than growing the heap.
+- **degrade routing** — when the resilience layer marks the device predict
+  path unhealthy (``degrade.worst("pallas_predict")`` != HEALTHY), the
+  admission verdict routes dispatches to the native CPU SoA walker
+  (``predictor/serving.py`` ``serving_context(force_native=True)``): the
+  server keeps answering at reduced throughput instead of queueing behind
+  a faulting device path. State transitions stay owned by the capability
+  machine (docs/resilience.md); this layer only *reads* it.
+
+Every decision is observable: ``requests_shed_total{reason=...}``,
+``serving_admitted_total``, ``serving_degraded_routes_total``.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional
+
+from ..observability.metrics import REGISTRY
+from ..resilience import degrade
+
+__all__ = ["RequestShed", "AdmissionController"]
+
+#: shed reasons (the ``reason`` label on ``requests_shed_total``)
+QUEUE_FULL = "queue_full"
+DEADLINE = "deadline"  # already past due at decision time
+SLO = "slo"  # projected completion overshoots the deadline
+
+#: p99 prior (seconds) used before the latency histogram has samples: a
+#: generous whole-bucket-walk estimate so a cold server does not shed its
+#: warm-up traffic on a fantasy backlog
+_COLD_P99_S = 0.050
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, str(default)))
+    except ValueError:
+        return default
+
+
+class RequestShed(RuntimeError):
+    """A request the server declined to serve (admission or dispatch-time
+    shed). ``reason`` is one of ``queue_full`` / ``deadline`` / ``slo``."""
+
+    def __init__(self, reason: str, detail: str = ""):
+        super().__init__(f"request shed ({reason})"
+                         + (f": {detail}" if detail else ""))
+        self.reason = reason
+
+
+class AdmissionController:
+    """Stateless-per-request decisions over shared observable state (queue
+    depth from the batcher, p99 from the metrics registry, health from the
+    degrade machine). One instance per :class:`~xgboost_tpu.serving.ModelServer`."""
+
+    def __init__(self, max_queue: Optional[int] = None):
+        self.max_queue = max(1, max_queue if max_queue is not None
+                             else _env_int("XGBTPU_SERVING_QUEUE", 1024))
+        # pre-create the families so a healthy server's exposition still
+        # documents the shed/admit surface (scrapers see zeros, not gaps)
+        self._shed = REGISTRY.counter(
+            "requests_shed_total",
+            "Requests declined by SLO-aware admission, by reason")
+        for reason in (QUEUE_FULL, DEADLINE, SLO):
+            self._shed.labels(reason=reason)
+        self._admitted = REGISTRY.counter(
+            "serving_admitted_total", "Requests admitted into the batcher")
+        self._degraded_routes = REGISTRY.counter(
+            "serving_degraded_routes_total",
+            "Dispatches routed to the native CPU walker because the "
+            "device predict path is degraded")
+        self._admitted.inc(0)
+        self._degraded_routes.inc(0)
+
+    # ------------------------------------------------------------------
+    def p99_s(self) -> float:
+        """Current p99 of the process-wide serving latency series, with
+        the cold-start prior when nothing was observed yet."""
+        q = REGISTRY.quantile("predict_latency_seconds", 0.99)
+        return _COLD_P99_S if q is None else max(q, 1e-6)
+
+    def admit(self, queue_depth: int,
+              deadline: Optional[float] = None) -> None:
+        """Raise :class:`RequestShed` if the request should not enter the
+        queue; record the admission otherwise. ``deadline`` is an absolute
+        ``time.monotonic()`` instant (None = no SLO)."""
+        if queue_depth >= self.max_queue:
+            self._shed.labels(reason=QUEUE_FULL).inc()
+            raise RequestShed(
+                QUEUE_FULL, f"queue depth {queue_depth} >= {self.max_queue}")
+        if deadline is not None:
+            now = time.monotonic()
+            if now >= deadline:
+                self._shed.labels(reason=DEADLINE).inc()
+                raise RequestShed(DEADLINE, "deadline already past at admit")
+            # projected completion: everything ahead of us plus our own
+            # dispatch, each at the observed tail latency
+            eta = (queue_depth + 1) * self.p99_s()
+            if now + eta > deadline:
+                self._shed.labels(reason=SLO).inc()
+                raise RequestShed(
+                    SLO, f"projected wait {eta * 1e3:.1f}ms past deadline "
+                         f"(queue depth {queue_depth}, "
+                         f"p99 {self.p99_s() * 1e3:.2f}ms)")
+        self._admitted.inc()
+
+    def shed_at_dispatch(self, reason: str = DEADLINE) -> RequestShed:
+        """Count and build the exception for a queued request that aged
+        out before its dispatch (the batcher resolves its future with it)."""
+        self._shed.labels(reason=reason).inc()
+        return RequestShed(reason, "deadline passed while queued")
+
+    # ------------------------------------------------------------------
+    def route_native(self) -> bool:
+        """The degrade machine's routing verdict for the next dispatch:
+        True = serve through the native CPU SoA walker. Counted so the
+        perf cliff is visible in the exposition while it lasts."""
+        if degrade.worst("pallas_predict") != degrade.HEALTHY:
+            self._degraded_routes.inc()
+            return True
+        return False

@@ -1,0 +1,251 @@
+"""Async micro-batcher: many small concurrent requests, one bucketed dispatch.
+
+The serving fast path (``predictor/serving.py``) pads every batch up to a
+power-of-two bucket, minimum 16 rows — so a 1-row request already pays for
+walking 16. This module fills that padding with *real traffic*: callers
+submit requests and get ``concurrent.futures.Future``s back; a single
+worker thread drains the bounded queue, coalesces compatible requests
+(same model snapshot, same predict options) into one concatenated matrix,
+runs ONE dispatch through the bucketed program cache, and slices the
+result back per caller. 64 concurrent 1-row requests become a handful of
+program invocations instead of 64 (pinned by tests/test_model_server.py).
+
+Knobs (env, read at construction):
+
+- ``XGBTPU_BATCH_WAIT_US`` (default 1000) — after the first request of a
+  cycle arrives, how long the worker waits for more traffic to coalesce.
+  0 = dispatch immediately, coalescing only what is already queued.
+- ``XGBTPU_BATCH_MAX_ROWS`` (default 4096) — rows per drain cycle; a full
+  cycle dispatches without waiting out the window.
+
+Correctness invariants: rows are walked per-row-independently on every
+route (XLA program, pallas, native walker), so a coalesced result is
+bit-identical to the same request served alone; requests that cannot
+coalesce (sparse inputs, explicit base margins) still ride the same queue
+but dispatch as their own group. Dispatch-time deadline re-checks shed
+requests that aged out while queued (``admission.py``).
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..observability.metrics import REGISTRY
+from .admission import AdmissionController
+from .tenancy import ModelEntry
+
+__all__ = ["MicroBatcher"]
+
+_STOP = object()
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, str(default)))
+    except ValueError:
+        return default
+
+
+class _Request:
+    __slots__ = ("entry", "X", "n", "group_key", "predict_type",
+                 "iteration_range", "missing", "base_margin", "deadline",
+                 "future")
+
+    def __init__(self, entry: ModelEntry, X, n: int, group_key: Tuple,
+                 predict_type: str, iteration_range, missing, base_margin,
+                 deadline: Optional[float]) -> None:
+        self.entry = entry
+        self.X = X
+        self.n = n
+        self.group_key = group_key
+        self.predict_type = predict_type
+        self.iteration_range = iteration_range
+        self.missing = missing
+        self.base_margin = base_margin
+        self.deadline = deadline
+        self.future: "Future" = Future()
+
+
+class MicroBatcher:
+    """The queue + worker thread. One per :class:`~xgboost_tpu.serving.ModelServer`;
+    admission decisions (queue bound, deadline shed, degrade routing) are
+    delegated to the attached :class:`AdmissionController`."""
+
+    def __init__(self, admission: Optional[AdmissionController] = None,
+                 *, max_wait_us: Optional[int] = None,
+                 max_batch_rows: Optional[int] = None) -> None:
+        self.admission = admission or AdmissionController()
+        if max_wait_us is None:
+            max_wait_us = _env_int("XGBTPU_BATCH_WAIT_US", 1000)
+        if max_batch_rows is None:
+            max_batch_rows = _env_int("XGBTPU_BATCH_MAX_ROWS", 4096)
+        self.max_wait_s = max(0, max_wait_us) / 1e6
+        self.max_batch_rows = max(1, max_batch_rows)
+        self._q: "queue.Queue" = queue.Queue()
+        self._depth = REGISTRY.gauge(
+            "serving_queue_depth", "Requests waiting in the batcher queue")
+        self._dispatches = REGISTRY.counter(
+            "serving_dispatches_total",
+            "Coalesced program dispatches issued by the micro-batcher")
+        self._batched = REGISTRY.counter(
+            "serving_requests_batched_total",
+            "Requests served through the micro-batcher")
+        self._rows = REGISTRY.counter(
+            "serving_rows_total", "Rows served through the micro-batcher")
+        self._depth.set(0)
+        self._dispatches.inc(0)
+        self._batched.inc(0)
+        self._closed = False
+        self._lock = threading.Lock()
+        self._worker = threading.Thread(
+            target=self._loop, name="xgbtpu-serving-batcher", daemon=True)
+        self._worker.start()
+
+    # ------------------------------------------------------------------
+    def submit(self, entry: ModelEntry, data, *,
+               predict_type: str = "value", iteration_range=None,
+               missing: float = np.nan, base_margin=None,
+               deadline: Optional[float] = None) -> "Future":
+        """Enqueue one predict request against a pinned model entry.
+        Returns a Future resolving to the prediction array (rows in input
+        order), or raising :class:`~xgboost_tpu.serving.RequestShed` /
+        the dispatch error. ``deadline`` is absolute ``time.monotonic()``."""
+        if iteration_range is not None \
+                and tuple(iteration_range) == (0, 0):
+            iteration_range = None
+        if hasattr(data, "tocsr") and hasattr(data, "nnz"):
+            # scipy sparse: ride the queue un-normalized (the serving
+            # entry consumes CSR directly), dispatched as its own group
+            X, coalescible = data, False
+        else:
+            X = entry.booster._inplace_normalize(data, missing)
+            if X is None:
+                raise TypeError(
+                    "micro-batcher inputs must be 2-D arrays or scipy "
+                    f"sparse matrices, got {type(data).__name__}")
+            missing = np.nan  # sentinel already folded into NaN
+            coalescible = base_margin is None
+        n = X.shape[0]
+        rkey = None if iteration_range is None else tuple(iteration_range)
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("model server is closed")
+            # qsize is exact under the lock only for submitters; the
+            # worker draining concurrently just makes admission lenient
+            self.admission.admit(self._q.qsize(), deadline)
+            req = _Request(
+                entry, X, n,
+                # sparse / base-margin requests get an identity key: they
+                # ride the drain cycle but dispatch as their own group
+                (id(entry), predict_type, rkey, X.shape[1])
+                if coalescible else (object(),),
+                predict_type, iteration_range, missing, base_margin,
+                deadline)
+            entry.acquire()
+            self._q.put(req)
+            self._depth.set(self._q.qsize())
+        return req.future
+
+    # ------------------------------------------------------------------
+    def _loop(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is _STOP:
+                break
+            batch = [item]
+            rows = item.n
+            window_end = time.monotonic() + self.max_wait_s
+            while rows < self.max_batch_rows:
+                remaining = window_end - time.monotonic()
+                try:
+                    nxt = self._q.get(timeout=max(0.0, remaining)) \
+                        if remaining > 0 else self._q.get_nowait()
+                except queue.Empty:
+                    break
+                if nxt is _STOP:
+                    self._q.put(_STOP)  # re-arm: exit after this batch
+                    break
+                batch.append(nxt)
+                rows += nxt.n
+            self._depth.set(self._q.qsize())
+            self._run_batch(batch)
+
+    def _run_batch(self, batch: List[_Request]) -> None:
+        groups: "Dict[Tuple, List[_Request]]" = {}
+        now = time.monotonic()
+        for req in batch:
+            if req.deadline is not None and now >= req.deadline:
+                self._resolve_err(req, self.admission.shed_at_dispatch())
+                continue
+            groups.setdefault(req.group_key, []).append(req)
+        force_native = self.admission.route_native() if groups else False
+        for grp in groups.values():
+            self._dispatch_group(grp, force_native)
+
+    def _dispatch_group(self, grp: List[_Request],
+                        force_native: bool) -> None:
+        first = grp[0]
+        try:
+            if len(grp) == 1:
+                X = first.X
+            else:
+                X = np.concatenate([r.X for r in grp], axis=0)
+            out = first.entry.predict(
+                X, predict_type=first.predict_type,
+                iteration_range=first.iteration_range,
+                missing=first.missing, base_margin=first.base_margin,
+                force_native=force_native)
+            self._dispatches.inc()
+            self._batched.inc(len(grp))
+            self._rows.inc(sum(r.n for r in grp))
+        except BaseException as e:  # noqa: BLE001 — worker must survive
+            for req in grp:
+                self._resolve_err(req, e)
+            return
+        off = 0
+        for req in grp:
+            req.entry.release()
+            req.future.set_result(np.asarray(out[off: off + req.n]))
+            off += req.n
+
+    @staticmethod
+    def _resolve_err(req: _Request, exc: BaseException) -> None:
+        req.entry.release()
+        req.future.set_exception(exc)
+
+    # ------------------------------------------------------------------
+    def queue_depth(self) -> int:
+        return self._q.qsize()
+
+    def close(self, drain: bool = True) -> None:
+        """Stop the worker. ``drain=True`` serves everything already
+        queued first; either way, requests that slip in after the stop
+        marker fail with a closed-server error instead of hanging."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            self._q.put(_STOP)
+        self._worker.join(timeout=60)
+        leftovers = []
+        while True:
+            try:
+                item = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if item is not _STOP:
+                leftovers.append(item)
+        for req in leftovers:
+            if drain:
+                # close() raced the worker's exit: serve rather than drop
+                self._dispatch_group([req], False)
+            else:
+                self._resolve_err(
+                    req, RuntimeError("model server closed before dispatch"))

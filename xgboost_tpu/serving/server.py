@@ -1,0 +1,309 @@
+"""``ModelServer``: the production serving front end, plus its JSONL loop.
+
+Composes the pieces of this package around the PR-2 fast path:
+:class:`~xgboost_tpu.serving.tenancy.ModelRegistry` (multi-model arena),
+:class:`~xgboost_tpu.serving.batcher.MicroBatcher` (request coalescing),
+:class:`~xgboost_tpu.serving.admission.AdmissionController` (SLO shed +
+degrade routing) and :func:`~xgboost_tpu.serving.swap.hot_swap`
+(zero-downtime version flips). Python callers use it directly::
+
+    srv = xgb.ModelServer({"fraud": "models/fraud.json"})
+    fut = srv.predict_async("fraud", rows, deadline_ms=15)
+    probs = fut.result()
+    srv.swap("fraud", "ckpts/fraud/")     # newest verified checkpoint
+    srv.close()
+
+Non-Python callers use the line protocol (``python -m xgboost_tpu serve``,
+one JSON document per line, same schema on stdin/stdout or a TCP socket —
+``docs/serving.md`` has the op catalog).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socketserver
+import sys
+import threading
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..observability.metrics import REGISTRY
+from .admission import AdmissionController, RequestShed
+from .batcher import MicroBatcher
+from .swap import SwapRunner, warm_entry
+from .tenancy import ModelRegistry
+
+__all__ = ["ModelServer", "serve_main"]
+
+
+class ModelServer:
+    """Async, micro-batched, multi-tenant model server (docs/serving.md).
+
+    Construction knobs mirror the env vars so embedded use never needs
+    ``os.environ`` games: ``arena_mb`` (XGBTPU_SERVING_ARENA_MB),
+    ``max_queue`` (XGBTPU_SERVING_QUEUE), ``batch_wait_us``
+    (XGBTPU_BATCH_WAIT_US), ``max_batch_rows`` (XGBTPU_BATCH_MAX_ROWS).
+    ``models`` maps name -> source (model JSON path/bytes, live Booster,
+    or PR-4 checkpoint file/directory)."""
+
+    def __init__(self, models: Optional[Dict[str, Any]] = None, *,
+                 arena_mb: Optional[float] = None,
+                 max_queue: Optional[int] = None,
+                 batch_wait_us: Optional[int] = None,
+                 max_batch_rows: Optional[int] = None) -> None:
+        self.registry = ModelRegistry(arena_mb)
+        self.admission = AdmissionController(max_queue)
+        self.batcher = MicroBatcher(
+            self.admission, max_wait_us=batch_wait_us,
+            max_batch_rows=max_batch_rows)
+        self._swapper = SwapRunner(self.registry)
+        self._closed = False
+        if models:
+            for name, source in models.items():
+                self.load(name, source)
+
+    # ------------------------------------------------------------------
+    def load(self, name: str, source: Any, *,
+             version: Optional[int] = None, warm: bool = True) -> str:
+        """Load a model version and make it live. Returns ``name@vN``."""
+        booster = source if hasattr(source, "save_raw") else None
+        entry = self.registry.load(name, source, version=version,
+                                   booster=booster)
+        if warm:
+            warm_entry(entry)
+        return entry.label
+
+    def swap(self, name: str, source: Any, *,
+             version: Optional[int] = None, block: bool = True,
+             drain_timeout_s: float = 60.0):
+        """Zero-downtime swap to a new version (``swap.py``): warm in the
+        background, flip atomically, drain the old snapshot. ``block=False``
+        returns the swap thread instead of the new label."""
+        booster = source if hasattr(source, "save_raw") else None
+        if block:
+            return self._swapper.swap(
+                name, source, version=version, booster=booster,
+                drain_timeout_s=drain_timeout_s).label
+        return self._swapper.swap_async(
+            name, source, version=version, booster=booster,
+            drain_timeout_s=drain_timeout_s)
+
+    # ------------------------------------------------------------------
+    def predict_async(self, name: str, data, *,
+                      deadline_ms: Optional[float] = None,
+                      version: Optional[int] = None,
+                      predict_type: str = "value", iteration_range=None,
+                      missing: float = np.nan,
+                      base_margin=None) -> "Future":
+        """Admit + enqueue one request; the Future resolves to the
+        prediction (or raises :class:`RequestShed` / the dispatch error)."""
+        import time
+
+        if self._closed:
+            raise RuntimeError("model server is closed")
+        deadline = (time.monotonic() + deadline_ms / 1e3
+                    if deadline_ms is not None else None)
+        entry = self.registry.get(name, version)
+        return self.batcher.submit(
+            entry, data, predict_type=predict_type,
+            iteration_range=iteration_range, missing=missing,
+            base_margin=base_margin, deadline=deadline)
+
+    def predict(self, name: str, data, *,
+                timeout: Optional[float] = 60.0, **kw) -> np.ndarray:
+        return self.predict_async(name, data, **kw).result(timeout)
+
+    # ------------------------------------------------------------------
+    def metrics(self) -> str:
+        """Prometheus text exposition of the process registry."""
+        return REGISTRY.exposition()
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "arena": self.registry.stats(),
+            "queue_depth": self.batcher.queue_depth(),
+            "p99_s": self.admission.p99_s(),
+        }
+
+    def close(self, drain: bool = True) -> None:
+        if not self._closed:
+            self._closed = True
+            self.batcher.close(drain=drain)
+
+    def __enter__(self) -> "ModelServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+# ---------------------------------------------------------------------------
+# JSONL line protocol (stdin/stdout or TCP): the test/ops surface of the
+# server. One JSON object per line; every request gets exactly one JSON
+# response line. Ops: predict, load, swap, metrics, stats, shutdown.
+# ---------------------------------------------------------------------------
+
+
+def _handle(server: ModelServer, msg: Dict[str, Any],
+            shutdown) -> Dict[str, Any]:
+    op = msg.get("op", "predict")
+    rid = msg.get("id")
+    out: Dict[str, Any] = {} if rid is None else {"id": rid}
+    try:
+        if op == "predict":
+            data = np.asarray(msg["data"], np.float32)
+            if data.ndim == 1:  # single-row convenience
+                data = data.reshape(1, -1)
+            result = server.predict(
+                msg.get("model", "default"), data,
+                deadline_ms=msg.get("deadline_ms"),
+                predict_type=("margin" if msg.get("margin")
+                              else "value"),
+                iteration_range=(tuple(msg["iteration_range"])
+                                 if msg.get("iteration_range") else None),
+                missing=float(msg.get("missing", "nan")),
+                timeout=msg.get("timeout_s", 60.0))
+            out["result"] = np.asarray(result, np.float64).tolist()
+        elif op == "load":
+            out["version"] = server.load(
+                msg["model"], msg["path"], version=msg.get("version"))
+            out["ok"] = True
+        elif op == "swap":
+            out["version"] = server.swap(
+                msg["model"], msg["path"], version=msg.get("version"))
+            out["ok"] = True
+        elif op == "metrics":
+            out["metrics"] = server.metrics()
+        elif op == "stats":
+            out["stats"] = server.stats()
+        elif op == "shutdown":
+            out["ok"] = True
+            shutdown()
+        else:
+            out["error"] = f"unknown op: {op!r}"
+    except RequestShed as e:
+        out["error"] = str(e)
+        out["shed"] = e.reason
+    except Exception as e:  # noqa: BLE001 — protocol surface: report, don't die
+        out["error"] = f"{type(e).__name__}: {e}"
+    return out
+
+
+def _parse_serve_args(argv: List[str]) -> Dict[str, Any]:
+    opts: Dict[str, Any] = {"models": {}, "port": None, "stdin": False,
+                            "host": "127.0.0.1"}
+    flags = {"--port": ("port", int), "--arena-mb": ("arena_mb", float),
+             "--batch-wait-us": ("batch_wait_us", int),
+             "--max-queue": ("max_queue", int), "--host": ("host", str)}
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a == "--stdin":
+            opts["stdin"] = True
+        elif a == "--model":
+            i += 1
+            name, sep, path = argv[i].partition("=")
+            if not sep:
+                raise ValueError("--model takes name=path")
+            opts["models"][name] = path
+        elif a in flags:
+            key, conv = flags[a]
+            i += 1
+            opts[key] = conv(argv[i])
+        else:
+            raise ValueError(f"unknown serve option: {a!r}")
+        i += 1
+    if opts["port"] is None and not opts["stdin"]:
+        raise ValueError("serve needs --port N or --stdin")
+    return opts
+
+
+def serve_main(argv: List[str], stdin=None, stdout=None) -> int:
+    """``python -m xgboost_tpu serve`` entry. ``--stdin`` serves the line
+    protocol over stdio (subprocess-pipe tests); ``--port N`` serves it
+    over TCP with a thread per connection, so concurrent client
+    connections coalesce in the micro-batcher. ``stdin``/``stdout``
+    overrides exist for in-process tests."""
+    try:
+        opts = _parse_serve_args(argv)
+    except (ValueError, IndexError) as e:
+        print(f"serve: {e}", file=sys.stderr)
+        print("usage: python -m xgboost_tpu serve (--port N | --stdin) "
+              "[--model name=path ...] [--arena-mb M] [--batch-wait-us U] "
+              "[--max-queue Q] [--host H]", file=sys.stderr)
+        return 1
+    stdin = stdin if stdin is not None else sys.stdin
+    stdout = stdout if stdout is not None else sys.stdout
+    server = ModelServer(
+        opts["models"], arena_mb=opts.get("arena_mb"),
+        max_queue=opts.get("max_queue"),
+        batch_wait_us=opts.get("batch_wait_us"))
+
+    def respond(obj: Dict[str, Any], fh) -> None:
+        fh.write(json.dumps(obj) + "\n")
+        fh.flush()
+
+    if opts["stdin"]:
+        stop = {"flag": False}
+
+        def shutdown() -> None:
+            stop["flag"] = True
+
+        for line in stdin:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                msg = json.loads(line)
+            except ValueError as e:
+                respond({"error": f"bad json: {e}"}, stdout)
+                continue
+            respond(_handle(server, msg, shutdown), stdout)
+            if stop["flag"]:
+                break
+        server.close()
+        return 0
+
+    class Handler(socketserver.StreamRequestHandler):
+        def handle(self) -> None:
+            for raw in self.rfile:
+                line = raw.decode("utf-8", "replace").strip()
+                if not line:
+                    continue
+                try:
+                    msg = json.loads(line)
+                except ValueError as e:
+                    out = {"error": f"bad json: {e}"}
+                else:
+                    out = _handle(server, msg, shutdown)
+                try:
+                    self.wfile.write(
+                        (json.dumps(out) + "\n").encode())
+                    self.wfile.flush()
+                except OSError:
+                    return  # client went away mid-response
+
+    class Srv(socketserver.ThreadingTCPServer):
+        allow_reuse_address = True
+        daemon_threads = True
+
+    tcp = Srv((opts["host"], opts["port"]), Handler)
+
+    def shutdown() -> None:
+        threading.Thread(target=tcp.shutdown, daemon=True).start()
+
+    host, port = tcp.server_address[:2]
+    print(f"READY serving on {host}:{port} "
+          f"(models: {', '.join(sorted(opts['models'])) or 'none'} "
+          f"pid={os.getpid()})", file=stdout, flush=True)
+    try:
+        tcp.serve_forever(poll_interval=0.1)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        tcp.server_close()
+        server.close()
+    return 0

@@ -1,0 +1,361 @@
+"""Multi-model tenancy: a forest-snapshot arena with an LRU memory budget.
+
+One serving process hosts many models (the reference serves this from its
+bindings tier — one ``Booster`` handle per model, the host application
+doing its own bookkeeping). Here the bookkeeping is first-class:
+
+- models are resident by ``name@version``; the **serving pointer** per
+  name is the live version (hot swap flips it atomically — ``swap.py``);
+- every resident entry is charged its device/host footprint (stacked
+  forest tensors + raw model JSON) against an explicit arena budget
+  (``XGBTPU_SERVING_ARENA_MB``, default 512). Loading past the budget
+  evicts least-recently-*used* entries — including stale versions left
+  behind by swaps — until the new model fits;
+- an evicted model is not gone: its **source** (raw model bytes, a model
+  file, or a PR-4 checksummed checkpoint directory) is retained, so the
+  next request faults it back in (a registry *miss*) instead of erroring.
+  ``hits + misses == get() calls`` is a pinned invariant
+  (tests/test_model_server.py).
+
+Registry metrics: ``serving_arena_bytes`` / ``serving_models_resident``
+gauges, ``serving_model_loads_total{model=}``,
+``serving_model_evictions_total``, ``serving_model_hits_total`` /
+``serving_model_misses_total``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..observability.metrics import REGISTRY
+
+__all__ = ["ModelEntry", "ModelRegistry", "resolve_source", "load_booster"]
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, str(default)))
+    except ValueError:
+        return default
+
+
+# ---------------------------------------------------------------------------
+# model sources: everything a model can be (re)loaded from
+# ---------------------------------------------------------------------------
+
+
+def resolve_source(source: Any) -> Tuple[str, Any]:
+    """Normalize a user-supplied model source into a (kind, payload) spec
+    that survives eviction: a live ``Booster`` becomes its raw JSON bytes,
+    paths stay paths. Kinds: ``raw`` (model JSON bytes), ``file`` (model
+    JSON path), ``ckpt`` (one checkpoint file), ``ckpt_dir`` (checkpoint
+    directory — newest *verified* snapshot wins, docs/resilience.md)."""
+    if hasattr(source, "save_raw"):  # live Booster
+        return ("raw", source.save_raw())
+    if isinstance(source, (bytes, bytearray)):
+        return ("raw", bytes(source))
+    if isinstance(source, (str, os.PathLike)):
+        path = os.fspath(source)
+        if os.path.isdir(path):
+            return ("ckpt_dir", path)
+        if path.endswith(".ckpt"):
+            return ("ckpt", path)
+        return ("file", path)
+    raise TypeError(f"unsupported model source: {type(source).__name__}")
+
+
+def load_booster(spec: Tuple[str, Any]):
+    """A fresh ``Booster`` from a resolved source spec. Checkpoint kinds
+    go through the resilience layer's verified readers, so a truncated or
+    bit-flipped snapshot is rejected (or fallen through) instead of served."""
+    from ..learner import Booster
+    from ..resilience import checkpoint
+
+    kind, payload = spec
+    if kind == "raw":
+        return Booster(model_file=bytes(payload))
+    if kind == "file":
+        return Booster(model_file=payload)
+    if kind == "ckpt":
+        got = checkpoint.read_checkpoint(payload)
+        if got is None:
+            raise ValueError(f"checkpoint {payload!r} failed verification")
+        return Booster(model_file=got[0])
+    if kind == "ckpt_dir":
+        got = checkpoint.load_latest(payload)
+        if got is None:
+            raise ValueError(
+                f"no verified checkpoint in {payload!r} "
+                "(python -m xgboost_tpu checkpoint-inspect)")
+        return Booster(model_file=got[0])
+    raise ValueError(f"unknown source kind: {kind!r}")
+
+
+def _forest_footprint_bytes(booster) -> int:
+    """Resident footprint estimate: the stacked forest's tensor bytes
+    (computed from shapes — no device->host sync) plus the tree store's
+    JSON size. The full-model snapshot is built here if absent, which is
+    exactly the warm-up a fresh model wants before serving."""
+    forest, tw = booster._forest_snapshot()
+    total = 0
+    for field in ("left", "right", "feature", "cond", "default_left",
+                  "split_type", "cat_bits", "tree_group"):
+        a = getattr(forest, field)
+        total += int(np.prod(a.shape, dtype=np.int64)) * a.dtype.itemsize
+    if tw is not None:
+        total += int(np.prod(tw.shape, dtype=np.int64)) * tw.dtype.itemsize
+    return total
+
+
+# ---------------------------------------------------------------------------
+
+
+class ModelEntry:
+    """One resident ``name@version``: the Booster, its footprint charge,
+    and an in-flight count so hot swap can drain requests pinned to the
+    old snapshot before releasing it."""
+
+    def __init__(self, name: str, version: int, booster, spec,
+                 nbytes: int) -> None:
+        self.name = name
+        self.version = version
+        self.label = f"{name}@v{version}"
+        self.booster = booster
+        self.spec = spec
+        self.nbytes = nbytes
+        self._cv = threading.Condition()
+        self._inflight = 0
+
+    # -- in-flight pinning ------------------------------------------------
+    def acquire(self) -> "ModelEntry":
+        with self._cv:
+            self._inflight += 1
+        return self
+
+    def release(self) -> None:
+        with self._cv:
+            self._inflight = max(0, self._inflight - 1)
+            self._cv.notify_all()
+
+    @property
+    def inflight(self) -> int:
+        with self._cv:
+            return self._inflight
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Block until no request holds this entry (True) or the timeout
+        expires (False). The swap path calls this on the *old* snapshot
+        after flipping the pointer: new traffic can no longer acquire it,
+        so the count only falls."""
+        with self._cv:
+            return self._cv.wait_for(lambda: self._inflight == 0, timeout)
+
+    # -- the dispatch the batcher runs ------------------------------------
+    def predict(self, X, *, predict_type: str = "value",
+                iteration_range=None, missing=np.nan, base_margin=None,
+                force_native: bool = False) -> np.ndarray:
+        """One coalesced dispatch through the bucketed serving fast path,
+        scoped to this tenant (per-model ``predict_latency_seconds``
+        labels; ``force_native`` is the admission layer's degrade route)."""
+        from ..predictor.serving import serving_context
+
+        with serving_context(model=self.label, force_native=force_native):
+            return self.booster.inplace_predict(
+                X, predict_type=predict_type,
+                iteration_range=iteration_range, missing=missing,
+                base_margin=base_margin)
+
+
+class ModelRegistry:
+    """The arena: name@version -> :class:`ModelEntry`, LRU-ordered, under
+    a byte budget. All mutation is lock-guarded; entries a swap just
+    replaced stay alive (and addressable by explicit version) until
+    evicted or released."""
+
+    def __init__(self, arena_mb: Optional[float] = None) -> None:
+        if arena_mb is None:
+            arena_mb = _env_float("XGBTPU_SERVING_ARENA_MB", 512.0)
+        self.budget_bytes = max(1, int(arena_mb * 1024 * 1024))
+        self._lock = threading.RLock()
+        self._entries: "OrderedDict[Tuple[str, int], ModelEntry]" = \
+            OrderedDict()
+        self._live: Dict[str, int] = {}  # serving pointer per name
+        self._sources: Dict[Tuple[str, int], Tuple[str, Any]] = {}
+        self._next_version: Dict[str, int] = {}
+        self._g_bytes = REGISTRY.gauge(
+            "serving_arena_bytes",
+            "Resident bytes of stacked-forest snapshots in the model arena")
+        self._g_models = REGISTRY.gauge(
+            "serving_models_resident", "Models resident in the arena")
+        self._hits = REGISTRY.counter(
+            "serving_model_hits_total",
+            "Model lookups served by a resident arena entry")
+        self._misses = REGISTRY.counter(
+            "serving_model_misses_total",
+            "Model lookups that faulted the model back in from its source")
+        self._evictions = REGISTRY.counter(
+            "serving_model_evictions_total",
+            "Arena entries evicted by the LRU memory budget")
+        self._g_bytes.set(0)
+        self._g_models.set(0)
+
+    # ------------------------------------------------------------------
+    def load(self, name: str, source: Any, *,
+             version: Optional[int] = None, make_live: bool = True,
+             booster=None) -> ModelEntry:
+        """Load (or re-register) a model version. ``source`` is anything
+        :func:`resolve_source` accepts; ``booster`` short-circuits the
+        load with an already-built instance (the in-process path — the
+        resolved source is still retained for fault-back-in). Returns the
+        resident entry; with ``make_live`` the serving pointer flips to it
+        (the caller sequences draining — see ``swap.py``)."""
+        spec = resolve_source(source)
+        if booster is None:
+            booster = load_booster(spec)
+        with self._lock:
+            if version is None:
+                version = self._next_version.get(name, 0) + 1
+            self._next_version[name] = max(
+                version, self._next_version.get(name, 0))
+        # footprint (builds the forest snapshot == warms the model) runs
+        # outside the lock: stacking a big forest must not stall lookups
+        nbytes = _forest_footprint_bytes(booster) + _spec_bytes(spec)
+        entry = ModelEntry(name, version, booster, spec, nbytes)
+        with self._lock:
+            key = (name, version)
+            self._entries[key] = entry
+            self._entries.move_to_end(key)
+            self._sources[key] = spec
+            if make_live:
+                self._live[name] = version
+            REGISTRY.counter(
+                "serving_model_loads_total",
+                "Models (re)loaded into the arena").labels(
+                    model=entry.label).inc()
+            self._evict_to_budget_locked(keep=key)
+            self._publish_locked()
+        return entry
+
+    def get(self, name: str, version: Optional[int] = None) -> ModelEntry:
+        """The resident entry for ``name`` (live version unless pinned).
+        A budget-evicted model faults back in from its retained source —
+        counted as a miss; resident lookups are hits."""
+        with self._lock:
+            v = version if version is not None else self._live.get(name)
+            if v is None:
+                raise KeyError(f"unknown model: {name!r}")
+            key = (name, v)
+            entry = self._entries.get(key)
+            if entry is not None:
+                self._entries.move_to_end(key)
+                self._hits.inc()
+                return entry
+            spec = self._sources.get(key)
+            if spec is None:
+                raise KeyError(f"unknown model version: {name!r} v{v}")
+            self._misses.inc()
+        # reload outside the lock (may read disk / restack the forest)
+        booster = load_booster(spec)
+        nbytes = _forest_footprint_bytes(booster) + _spec_bytes(spec)
+        entry = ModelEntry(name, v, booster, spec, nbytes)
+        with self._lock:
+            raced = self._entries.get(key)
+            if raced is not None:  # another thread faulted it in first
+                self._entries.move_to_end(key)
+                return raced
+            self._entries[key] = entry
+            self._evict_to_budget_locked(keep=key)
+            self._publish_locked()
+        return entry
+
+    def set_live(self, name: str, version: int) -> ModelEntry:
+        """Atomically flip the serving pointer (the entry must exist)."""
+        with self._lock:
+            if (name, version) not in self._entries \
+                    and (name, version) not in self._sources:
+                raise KeyError(f"unknown model version: {name!r} v{version}")
+            self._live[name] = version
+        return self.get(name)
+
+    def live_version(self, name: str) -> Optional[int]:
+        with self._lock:
+            return self._live.get(name)
+
+    def drop(self, name: str, version: Optional[int] = None) -> None:
+        """Forget a model (all versions unless one is pinned): entries,
+        sources and the serving pointer."""
+        with self._lock:
+            keys = [k for k in set(self._entries) | set(self._sources)
+                    if k[0] == name and (version is None or k[1] == version)]
+            for k in keys:
+                self._entries.pop(k, None)
+                self._sources.pop(k, None)
+            if version is None or self._live.get(name) == version:
+                self._live.pop(name, None)
+            self._publish_locked()
+
+    # ------------------------------------------------------------------
+    def total_bytes(self) -> int:
+        with self._lock:
+            return sum(e.nbytes for e in self._entries.values())
+
+    def resident(self) -> List[str]:
+        with self._lock:
+            return [e.label for e in self._entries.values()]
+
+    def models(self) -> Dict[str, int]:
+        """name -> live version (the serving pointers)."""
+        with self._lock:
+            return dict(self._live)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "budget_bytes": self.budget_bytes,
+                "resident_bytes": sum(
+                    e.nbytes for e in self._entries.values()),
+                "resident": [
+                    {"model": e.label, "bytes": e.nbytes,
+                     "inflight": e.inflight,
+                     "live": self._live.get(e.name) == e.version}
+                    for e in self._entries.values()
+                ],
+                "live": {n: f"{n}@v{v}" for n, v in self._live.items()},
+            }
+
+    # ------------------------------------------------------------------
+    def _evict_to_budget_locked(self, keep: Tuple[str, int]) -> None:
+        """Drop least-recently-used entries until under budget. The entry
+        being installed is exempt (a model bigger than the whole budget
+        still serves — the arena just holds nothing else). In-flight
+        entries are skipped this pass: their memory is pinned by the
+        requests anyway, and dropping the registry's reference would only
+        hide the bytes from the gauge."""
+        total = sum(e.nbytes for e in self._entries.values())
+        if total <= self.budget_bytes:
+            return
+        for key in list(self._entries):
+            if total <= self.budget_bytes:
+                break
+            if key == keep:
+                continue
+            entry = self._entries[key]
+            if entry.inflight:
+                continue
+            del self._entries[key]
+            total -= entry.nbytes
+            self._evictions.inc()
+
+    def _publish_locked(self) -> None:
+        self._g_bytes.set(sum(e.nbytes for e in self._entries.values()))
+        self._g_models.set(len(self._entries))
+
+
+def _spec_bytes(spec: Tuple[str, Any]) -> int:
+    kind, payload = spec
+    return len(payload) if kind == "raw" else 0
