@@ -532,6 +532,10 @@ def _served_bench(bst, Xs: np.ndarray, n_threads: int = 8,
         dispatches = counter("serving_dispatches_total") - d0
         batched = counter("serving_requests_batched_total") - b0
         coalesce = batched / max(dispatches, 1.0)
+        # the SLO ledger's view of the same run (ISSUE 9): per-stage
+        # p50/p99 says where a served request's time went — queue,
+        # coalescing window, or the dispatch itself
+        slo = srv.stats()["slo"]
     finally:
         srv.close()
     served_rps = total_rows / max(served_s, 1e-9)
@@ -541,6 +545,14 @@ def _served_bench(bst, Xs: np.ndarray, n_threads: int = 8,
           f"{n_requests} ragged reqs, coalescing {coalesce:.1f} req/dispatch"
           f" over {dispatches:.0f} dispatches)",
           file=sys.stderr, flush=True)
+    stage_ms = {
+        stage: {k: round(v * 1e3, 3) for k, v in qs.items()}
+        for stage, qs in slo.get("stages", {}).items()}
+    if stage_ms:
+        print("# served stage latency (ms): " + "; ".join(
+            f"{stage} p50={qs.get('p50', 0)} p99={qs.get('p99', 0)}"
+            for stage, qs in stage_ms.items()),
+            file=sys.stderr, flush=True)
     _log_partial({"config": "predict_served",
                   "metric": "predict_served_rows_per_s",
                   "value": round(served_rps, 1),
@@ -548,7 +560,8 @@ def _served_bench(bst, Xs: np.ndarray, n_threads: int = 8,
                   "threads": n_threads, "requests": n_requests,
                   "rows": total_rows,
                   "coalesce_ratio": round(coalesce, 2),
-                  "dispatches": int(dispatches)})
+                  "dispatches": int(dispatches),
+                  "stage_latency_ms": stage_ms})
 
 
 def _report_arithmetic_intensity() -> None:

@@ -223,15 +223,21 @@ print(f"obs-report OK: {len(merged)} merged events, ranks {sorted(pids)}, "
       "membership instants + elastic rollup + SIGKILL black box present")
 EOF
 
-echo "=== tier 1.7: serving smoke lane (model server CLI) ==="
+echo "=== tier 1.7: serving smoke lane (model server CLI + serve-report) ==="
 # The production model server end to end, the way an operator runs it:
-# start `python -m xgboost_tpu serve` on a TCP port with a v1 model,
-# drive concurrent client connections (so the micro-batcher actually
-# coalesces), hot-swap to v2 MID-TRAFFIC, and require zero failed
-# requests plus the serving metrics (model_swaps_total,
-# requests_shed_total) in the exposition (docs/serving.md).
+# start `python -m xgboost_tpu serve` on a TCP port with a v1 model AND
+# a --run-dir observability sink, drive 8 concurrent client connections
+# (so the micro-batcher actually coalesces) sending request_ids — with a
+# seeded subset carrying an already-lapsed deadline so real sheds happen
+# — hot-swap to v2 MID-TRAFFIC, and require zero unexpected failures
+# plus the serving metrics in the exposition. Then the request-scope
+# observability contract (ISSUE 9): one access-log line per request,
+# `serve-report` printing per-model p50/p99 + the shed timeline with the
+# swap on it + the exemplar table, and the per-request spans loadable
+# from the merged Chrome trace (docs/serving.md "Tracing a request").
 python - <<'EOF'
-import json, os, socket, subprocess, sys, tempfile, threading, time
+import io, json, os, socket, subprocess, sys, tempfile, threading, time
+from contextlib import redirect_stdout
 
 import numpy as np
 
@@ -243,6 +249,7 @@ y = (X[:, 0] > 0).astype(np.float32)
 params = {"objective": "binary:logistic", "max_depth": 3, "max_bin": 16,
           "verbosity": 0}
 tmp = tempfile.mkdtemp(prefix="ci_serving_")
+run_dir = os.path.join(tmp, "run")
 v1 = xgb.train(params, xgb.DMatrix(X, label=y), 3)
 v1_path = os.path.join(tmp, "v1.json"); v1.save_model(v1_path)
 v2 = xgb.train(dict(params, seed=5), xgb.DMatrix(X, label=y), 4)
@@ -252,9 +259,11 @@ s = socket.socket(); s.bind(("127.0.0.1", 0))
 port = s.getsockname()[1]; s.close()
 env = dict(os.environ)
 env["PYTHONPATH"] = os.getcwd() + os.pathsep + env.get("PYTHONPATH", "")
+env.pop("XGBTPU_TRACE", None)  # request spans go to the run_dir sink
 proc = subprocess.Popen(
     [sys.executable, "-m", "xgboost_tpu", "serve", "--port", str(port),
-     "--model", f"m={v1_path}", "--batch-wait-us", "2000"],
+     "--model", f"m={v1_path}", "--batch-wait-us", "2000",
+     "--run-dir", run_dir],
     env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
 try:
     ready = proc.stdout.readline()
@@ -269,44 +278,109 @@ try:
             buf += chunk
         return json.loads(buf)
 
-    failures, served = [], [0]
+    N_CLIENTS, PER = 8, 25
+    failures, served, shed = [], [0], [0]
     def traffic(k):
-        c = socket.create_connection(("127.0.0.1", port), timeout=60)
+        c = socket.create_connection(("127.0.0.1", port), timeout=120)
         try:
-            for i in range(25):
+            for i in range(PER):
                 lo = (k * 37 + i * 7) % 350
-                r = rpc(c, {"op": "predict", "id": f"{k}-{i}", "model": "m",
-                            "data": X[lo:lo + 1 + (i % 4)].tolist()})
-                if "error" in r:
+                req = {"op": "predict", "id": f"{k}-{i}", "model": "m",
+                       "data": X[lo:lo + 1 + (i % 4)].tolist(),
+                       "timeout_s": 120.0}
+                if i % 12 == 7:  # seeded sheds: deadline already lapsed
+                    req["deadline_ms"] = 0
+                r = rpc(c, req)
+                # every response carries the request id it was traced as
+                if r.get("request_id") != f"{k}-{i}":
+                    failures.append(("bad request_id echo", r))
+                elif r.get("shed"):
+                    shed[0] += 1
+                    if i % 12 != 7:
+                        failures.append(("unexpected shed", r))
+                elif "error" in r:
                     failures.append(r)
                 else:
                     served[0] += 1
         finally:
             c.close()
 
-    threads = [threading.Thread(target=traffic, args=(k,)) for k in range(4)]
+    threads = [threading.Thread(target=traffic, args=(k,))
+               for k in range(N_CLIENTS)]
     for t in threads: t.start()
     time.sleep(0.3)  # let traffic build, then swap under it
-    ctl = socket.create_connection(("127.0.0.1", port), timeout=60)
+    ctl = socket.create_connection(("127.0.0.1", port), timeout=120)
     r = rpc(ctl, {"op": "swap", "model": "m", "path": v2_path})
     assert r.get("version") == "m@v2", r
     for t in threads: t.join()
     assert not failures, f"requests failed across the hot swap: {failures[:3]}"
+    total = N_CLIENTS * PER
+    assert served[0] + shed[0] == total, (served, shed)
+    assert shed[0] >= N_CLIENTS, f"seeded deadline sheds missing: {shed}"
     exp = rpc(ctl, {"op": "metrics"})["metrics"]
     assert 'model_swaps_total{model="m@v2"} 1' in exp, exp[-2000:]
-    assert "requests_shed_total" in exp, exp[-2000:]
+    assert 'requests_shed_total{reason="deadline"}' in exp, exp[-2000:]
     assert "serving_dispatches_total" in exp
+    assert "serving_dispatch_seconds" in exp  # SLO ledger histograms live
+    # stats op exposes the ledger without scraping metrics
+    slo = rpc(ctl, {"op": "stats"})["stats"]["slo"]
+    assert "p99" in slo["stages"]["dispatch"], slo
+    assert slo["deadline"]["miss"] >= shed[0], slo
+    assert "error_budget_burn" in slo
     # post-swap traffic is v2: full-batch check against the real model
-    post = rpc(ctl, {"op": "predict", "model": "m", "data": X[:8].tolist()})
+    post = rpc(ctl, {"op": "predict", "id": "post-swap", "model": "m",
+                     "data": X[:8].tolist()})
     ref = np.asarray(v2.inplace_predict(X[:8]), np.float64)
     assert np.allclose(post["result"], ref, atol=1e-6)
     rpc(ctl, {"op": "shutdown"}); ctl.close()
-    proc.wait(timeout=60)
-    print(f"serving smoke OK: {served[0]} requests, 0 failures, "
-          "hot swap mid-traffic, metrics exported")
+    proc.wait(timeout=120)
+    print(f"serving smoke OK: {served[0]} served + {shed[0]} shed of "
+          f"{total}, hot swap mid-traffic, metrics + stats exported")
 finally:
     if proc.poll() is None:
         proc.kill()
+
+# ---- request-scope observability (ISSUE 9 acceptance) ----
+server_dir = os.path.join(run_dir, "obs", "server")
+access = []
+for ln in open(os.path.join(server_dir, "access.jsonl")):
+    if ln.strip():
+        rec = json.loads(ln)
+        if rec.get("t") == "req":
+            access.append(rec)
+# one line per request: the 200 traffic requests + the post-swap check
+assert len(access) == total + 1, f"access log {len(access)} != {total + 1}"
+ids = {r["id"] for r in access}
+assert "post-swap" in ids and "0-0" in ids and f"{N_CLIENTS-1}-{PER-1}" in ids
+n_shed = sum(1 for r in access if r["outcome"] == "shed")
+assert n_shed == shed[0], (n_shed, shed)
+assert all(r["outcome"] != "ok" or "dispatch_s" in r for r in access)
+
+from xgboost_tpu.cli import cli_main
+buf = io.StringIO()
+with redirect_stdout(buf):
+    rc = cli_main(["serve-report", run_dir])
+out = buf.getvalue()
+assert rc == 0, f"serve-report failed (rc={rc}):\n{out}"
+# >= 1 model's percentiles, the swap on the timeline, the exemplar table
+assert "m@v1" in out and "m@v2" in out and "p50" in out and "p99" in out, out
+assert "model_swap(m@v2)" in out, out
+assert "shed[deadline]=" in out, out
+assert "worst-request exemplars" in out, out
+
+# per-request spans loadable in the merged Chrome trace
+from xgboost_tpu.observability import load_trace
+merged = load_trace(os.path.join(run_dir, "obs", "serve.trace.json"))
+tracks = {e.get("id") for e in merged
+          if e.get("ph") == "b" and e.get("name") == "request"}
+assert "0-0" in tracks and "post-swap" in tracks, sorted(tracks)[:10]
+batch_links = [e for e in merged if e.get("name") == "serving_dispatch"
+               and e.get("ph") == "X"]
+linked = sorted(i for e in batch_links for i in e["args"]["requests"])
+ok_ids = sorted(r["id"] for r in access if r["outcome"] == "ok")
+assert linked == ok_ids, "batch spans must link exactly the served ids"
+print(f"serve-report OK: {len(access)} access lines, {len(tracks)} request "
+      f"tracks, {len(batch_links)} batch spans, swap + sheds on timeline")
 EOF
 
 echo "=== tier 2: trace parses as Chrome trace JSON ==="

@@ -6,8 +6,10 @@ key=value config parser (``src/common/config.h``). Usage:
     python -m xgboost_tpu <config> [key=value ...]
     python -m xgboost_tpu trace-report <trace-file|glob> ... [--top N]
     python -m xgboost_tpu obs-report <run_dir> [--top-rounds N]
+    python -m xgboost_tpu serve-report <run_dir> [--top N]
     python -m xgboost_tpu checkpoint-inspect <dir>
     python -m xgboost_tpu serve (--port N | --stdin) [--model name=path ...]
+        [--run-dir D]
 
 Config keys mirror the reference: task, data, test:data, model_in,
 model_out, model_dir, num_round, save_period, eval[name]=path, dump_format,
@@ -17,7 +19,12 @@ summarizes Chrome trace-event files written via ``XGBTPU_TRACE``
 per-rank totals — ``docs/observability.md``). ``obs-report`` merges a
 fleet run's per-rank observability (``run_dir/obs/rank<k>/``) into one
 clock-aligned trace, a metrics rollup and a per-round fleet table
-(``observability/fleet.py``).
+(``observability/fleet.py``). ``serve-report`` is its serving-plane
+sibling: it merges a model server's ``run_dir/obs/server/`` access log,
+dispatch flight ring and request trace into per-model latency
+percentiles, a shed/degrade timeline, coalescing stats and a
+worst-request exemplar table (``observability/serve_report.py``,
+docs/serving.md "Tracing a request").
 ``lint`` runs the static-analysis gate (trace-safety / retrace / dtype /
 concurrency passes, ``docs/static_analysis.md``):
 
@@ -92,6 +99,10 @@ def cli_main(argv: List[str]) -> int:
         from .observability.fleet import main as fleet_main
 
         return fleet_main(argv[1:])
+    if argv[0] == "serve-report":
+        from .observability.serve_report import main as serve_report_main
+
+        return serve_report_main(argv[1:])
     if argv[0] == "lint":
         from .analysis.cli import main as lint_main
 

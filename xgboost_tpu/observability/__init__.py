@@ -15,9 +15,15 @@ accumulators, NVTX ranges, ``TrainingObserver`` dumps):
 - ``flight`` — the always-on per-round flight recorder (ring buffer,
   durable ``run_dir/obs/rank<k>/`` sink, black-box dumps, profiling
   window) — ISSUE 7;
-- ``report`` — the ``python -m xgboost_tpu trace-report`` summarizer;
+- ``report`` — the ``python -m xgboost_tpu trace-report`` summarizer
+  (per-span self times, span-category totals: serving vs train vs
+  collective);
 - ``fleet`` — the ``python -m xgboost_tpu obs-report`` cross-rank
-  merger (clock-aligned trace, metrics rollup, per-round fleet table).
+  merger (clock-aligned trace, metrics rollup, per-round fleet table);
+- ``serve_report`` — the ``python -m xgboost_tpu serve-report``
+  serving-plane report (per-model latency percentiles, shed/degrade
+  timeline, coalescing, worst-request exemplars) over a model server's
+  ``run_dir/obs/server/`` sink (``serving/obs.py`` — ISSUE 9).
 
 Everything is a no-op costing one branch per call site when disabled, and
 never records from inside ``jit``-traced code (host-side only).

@@ -41,8 +41,8 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from .trace import load_trace
 
-__all__ = ["collect", "merge_trace", "rollup_metrics", "fleet_table",
-           "format_fleet_report", "main"]
+__all__ = ["collect", "load_obs_dir", "merge_trace", "write_trace",
+           "rollup_metrics", "fleet_table", "format_fleet_report", "main"]
 
 _RANK_RE = re.compile(r"^rank(\d+)$")
 
@@ -125,6 +125,16 @@ class RankObs:
                 self.errors.append(
                     f"{os.path.basename(path)}: bad record at line {i + 1}")
         return out
+
+
+def load_obs_dir(path: str, rank: int = 0) -> RankObs:
+    """Load ONE observability directory outside the ``rank<k>`` naming —
+    the loader is layout-generic (flight/trace/metrics/clock sidecars),
+    so the serving plane's ``obs/server/`` directory (``serve-report``,
+    ``observability/serve_report.py``) reuses the same lenient parse and
+    the same clock-aligned ``merge_trace``/``rollup_metrics`` machinery
+    as a training rank. ``rank`` becomes the Chrome ``pid``."""
+    return RankObs(rank, path).load()
 
 
 def collect(run_dir: str) -> List[RankObs]:

@@ -59,7 +59,7 @@ from .metrics import REGISTRY
 
 __all__ = [
     "FlightRecorder", "RECORDER", "enabled", "note", "configure",
-    "stage_totals", "profile_tick", "profile_stop",
+    "stage_totals", "profile_tick", "profile_stop", "atomic_write_json",
 ]
 
 _ENV_FLIGHT = "XGBTPU_FLIGHT"
@@ -90,6 +90,22 @@ def _rank() -> int:
         return int(jax.process_index())
     except Exception:
         return 0
+
+
+def atomic_write_json(path: str, doc: Dict[str, Any]) -> bool:
+    """Replace-write ``doc`` as JSON (tmp + rename; no fsync — black-box
+    artifacts tolerate losing the very last dump on power cut). Shared by
+    the training black box here and the serving flight recorder
+    (``serving/obs.py``). Best effort: returns False instead of raising,
+    because a dump must never mask the abort it documents."""
+    try:
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, path)
+        return True
+    except (OSError, ValueError, TypeError):
+        return False
 
 
 def _rss_peak_mb() -> float:
@@ -393,12 +409,7 @@ class FlightRecorder:
             doc["metrics"] = REGISTRY.snapshot()
         except Exception:
             doc["metrics"] = {}
-        try:
-            tmp = f"{path}.tmp.{os.getpid()}"
-            with open(tmp, "w") as f:
-                json.dump(doc, f)
-            os.replace(tmp, path)
-        except (OSError, ValueError):
+        if not atomic_write_json(path, doc):
             return None
         self._refresh_sidecars()
         return path

@@ -244,6 +244,24 @@ class MetricsRegistry:
             child = fam._children.get(key)
         return None if child is None else child.quantile(q)
 
+    def quantiles(self, name: str, qs: Sequence[float] = (0.50, 0.99)
+                  ) -> List[Tuple[Dict[str, str], Dict[str, float]]]:
+        """Quantile estimates for EVERY series of a histogram family:
+        ``[(labels, {"p50": v, "p99": v}), ...]``, skipping series with
+        no observations. The one-call read the serving SLO ledger and the
+        ``stats`` op use to report per-model stage latencies without
+        walking a full ``snapshot()``."""
+        fam = self._families.get(name)
+        if fam is None or fam.kind != "histogram":
+            return []
+        out: List[Tuple[Dict[str, str], Dict[str, float]]] = []
+        for labels, child in fam.series():
+            if not child.count:
+                continue
+            out.append((labels, {f"p{float(q) * 100:g}":
+                                 child.quantile(q) for q in qs}))
+        return out
+
     def reset(self) -> None:
         """Drop every family (tests / between BENCH repetitions)."""
         with self._lock:

@@ -6,6 +6,11 @@ the accepted forms — see ``load_trace``) and prints:
 - per-span-name totals: call count, total (inclusive) time, **self time**
   (inclusive minus time spent in nested spans on the same rank+thread),
   ranked by self time — "where did this round's milliseconds go";
+- per-category totals: the Chrome ``cat`` field (the serving plane tags
+  its request/dispatch spans ``serving``), with uncategorized spans
+  bucketed as ``train`` and the known collective span names as
+  ``collective`` — so a mixed train+serve trace summarizes both planes
+  in one line;
 - per-rank (Chrome ``pid``) totals — "on which host";
 - counts of instant events.
 
@@ -61,6 +66,22 @@ def _self_times(events: List[Dict[str, Any]]) -> Dict[str, float]:
     return dict(self_us)
 
 
+#: uncategorized span names that belong to the collective plane (the
+#: host-side collective choke points emit these — ``collective.py``)
+_COLLECTIVE_NAMES = frozenset(
+    {"allreduce", "broadcast", "process_allgather", "psum", "all_gather"})
+
+
+def _category(ev: Dict[str, Any]) -> str:
+    cat = ev.get("cat")
+    if cat:
+        return str(cat)
+    name = str(ev.get("name", ""))
+    if name in _COLLECTIVE_NAMES or name.startswith("collective"):
+        return "collective"
+    return "train"
+
+
 def summarize(events: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
     events = list(events)
     complete = [e for e in events
@@ -68,6 +89,7 @@ def summarize(events: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
     instants = [e for e in events if e.get("ph") == "i"]
     per_name: Dict[str, Dict[str, float]] = {}
     per_rank: Dict[int, Dict[str, float]] = {}
+    per_cat: Dict[str, Dict[str, float]] = {}
     for ev in complete:
         s = per_name.setdefault(ev["name"], {"count": 0, "total_us": 0.0})
         s["count"] += 1
@@ -76,6 +98,10 @@ def summarize(events: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
                                 {"count": 0, "total_us": 0.0})
         r["count"] += 1
         r["total_us"] += ev["dur"]
+        c = per_cat.setdefault(_category(ev),
+                               {"count": 0, "total_us": 0.0})
+        c["count"] += 1
+        c["total_us"] += ev["dur"]
     for name, su in _self_times(complete).items():
         per_name.setdefault(name, {"count": 0, "total_us": 0.0})[
             "self_us"] = su
@@ -89,6 +115,7 @@ def summarize(events: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
         "n_spans": len(complete),
         "spans": per_name,
         "ranks": per_rank,
+        "categories": per_cat,
         "instants": dict(inst_counts),
     }
 
@@ -98,9 +125,18 @@ def _ms(us: float) -> str:
 
 
 def format_report(summary: Dict[str, Any], top: int = 20) -> str:
+    cats = summary.get("categories", {})
     lines = [
         f"trace: {summary['n_events']} events, "
         f"{summary['n_spans']} spans, {len(summary['ranks'])} rank(s)",
+    ]
+    if cats:
+        lines.append(
+            "span time by category: " + ", ".join(
+                f"{cat} {_ms(c['total_us'])} ({c['count']} spans)"
+                for cat, c in sorted(
+                    cats.items(), key=lambda kv: -kv[1]["total_us"])))
+    lines += [
         "",
         f"top spans by self time (top {top}):",
         f"  {'name':<28} {'count':>7} {'total':>12} {'self':>12} {'avg':>10}",

@@ -1,0 +1,280 @@
+"""Serving-plane report: ``python -m xgboost_tpu serve-report <dir>``.
+
+The sibling of ``obs-report`` for the traffic-facing half of the system
+(ISSUE 9). A :class:`~xgboost_tpu.serving.ModelServer` launched with a
+``run_dir`` (or ``XGBTPU_SERVE_DIR``) persists its request-scope
+observability under ``run_dir/obs/server/`` — ``access.jsonl`` (one line
+per request), ``flight.jsonl`` (per-dispatch ring + timeline events),
+``trace.jsonl`` (per-request async span tracks), ``metrics.json`` and
+``clock.json``. This module merges them into the operator's one-page
+answer to "what did traffic look like":
+
+- **latency percentiles per model** — p50/p99/max of request total time
+  plus queue-wait and dispatch p99, computed exactly from the access log
+  (the registry histograms stay the scrapeable approximation);
+- **shed/degrade timeline** — per-second buckets of ok / shed (by
+  reason) / error counts and native-routed dispatch counts, with model
+  load/swap/evict events inlined where they happened;
+- **coalescing** — requests per dispatch, route mix and program-cache
+  misses from the dispatch ring;
+- **worst-request exemplars** — the slowest requests with their full
+  stage breakdown (queue -> batch wait -> dispatch);
+- **merged Chrome trace** — ``obs/serve.trace.json``: span events plus
+  timeline events as instants, clock-aligned through the same
+  ``fleet.merge_trace`` machinery a training rank uses (loadable in
+  Perfetto; per-request tracks are nestable-async lanes).
+
+A machine-readable summary lands next to it as
+``obs/serve_report.json``. Partial data is expected input (a killed
+server's final line may be torn — same contract as ``obs-report``);
+a directory with no serving observability at all exits 1.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from collections import defaultdict
+from typing import Any, Dict, List, Optional, Tuple
+
+from . import fleet
+
+__all__ = ["load_server_obs", "summarize_access", "format_serve_report",
+           "main"]
+
+
+def _resolve_dir(path: str) -> Optional[str]:
+    """The ``obs/server`` directory for any of: a server run_dir, its
+    ``obs`` directory, or the server directory itself."""
+    for cand in (os.path.join(path, "obs", "server"),
+                 os.path.join(path, "server"), path):
+        if os.path.isfile(os.path.join(cand, "access.jsonl")) \
+                or os.path.isfile(os.path.join(cand, "flight.jsonl")):
+            return cand
+    return None
+
+
+def load_server_obs(path: str) -> Optional[Tuple[Any, List[Dict[str, Any]]]]:
+    """(RankObs-view of the server dir, access records) or None when
+    ``path`` holds no serving observability."""
+    d = _resolve_dir(path)
+    if d is None:
+        return None
+    obs = fleet.load_obs_dir(d, rank=0)
+    access = [rec for rec in obs._read_jsonl(
+        os.path.join(d, "access.jsonl")) if rec.get("t") == "req"]
+    return obs, access
+
+
+def _pct(sorted_vals: List[float], q: float) -> float:
+    """Exact empirical quantile (nearest-rank) of pre-sorted values."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(int(q * len(sorted_vals)), len(sorted_vals) - 1)
+    return sorted_vals[idx]
+
+
+def summarize_access(access: List[Dict[str, Any]],
+                     dispatches: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """The machine-readable summary the text report renders."""
+    outcomes: Dict[str, int] = defaultdict(int)
+    shed_reasons: Dict[str, int] = defaultdict(int)
+    per_model: Dict[str, List[Dict[str, Any]]] = defaultdict(list)
+    for rec in access:
+        outcomes[rec.get("outcome", "?")] += 1
+        if rec.get("shed"):
+            shed_reasons[rec["shed"]] += 1
+        per_model[rec.get("model", "?")].append(rec)
+    models: Dict[str, Any] = {}
+    for model, recs in sorted(per_model.items()):
+        ok = [r for r in recs if r.get("outcome") == "ok"]
+        totals = sorted(r.get("total_s", 0.0) for r in ok)
+        queues = sorted(r["queue_wait_s"] for r in ok
+                        if "queue_wait_s" in r)
+        disp = sorted(r["dispatch_s"] for r in ok if "dispatch_s" in r)
+        models[model] = {
+            "requests": len(recs), "ok": len(ok),
+            "rows": sum(int(r.get("rows", 0)) for r in recs),
+            "total_p50_s": _pct(totals, 0.50),
+            "total_p99_s": _pct(totals, 0.99),
+            "total_max_s": totals[-1] if totals else 0.0,
+            "queue_wait_p99_s": _pct(queues, 0.99),
+            "dispatch_p99_s": _pct(disp, 0.99),
+        }
+    routes: Dict[str, int] = defaultdict(int)
+    reqs = rows = misses = 0
+    for d in dispatches:
+        routes[d.get("route") or "?"] += 1
+        reqs += int(d.get("reqs", 0))
+        rows += int(d.get("rows", 0))
+        misses += int(d.get("cache_misses", 0))
+    return {
+        "requests": len(access),
+        "outcomes": dict(outcomes),
+        "shed_reasons": dict(shed_reasons),
+        "models": models,
+        "dispatches": len(dispatches),
+        "dispatched_rows": rows,
+        "coalesce_ratio": reqs / max(len(dispatches), 1),
+        "routes": dict(routes),
+        "cache_misses": misses,
+    }
+
+
+def _timeline(access: List[Dict[str, Any]],
+              events: List[Dict[str, Any]],
+              dispatches: List[Dict[str, Any]],
+              bucket_s: float = 1.0) -> List[Dict[str, Any]]:
+    """Per-``bucket_s`` activity rows: outcome counts, native-routed
+    dispatches, and the events that fell in the bucket — the shed/
+    degrade/swap story in order."""
+    stamps = [r["unix_ms"] for r in access + events + dispatches
+              if "unix_ms" in r]
+    if not stamps:
+        return []
+    base = min(stamps)
+    rows: Dict[int, Dict[str, Any]] = {}
+
+    def at(ms: float) -> Dict[str, Any]:
+        k = int((ms - base) / (bucket_s * 1e3))
+        return rows.setdefault(k, {
+            "t_s": k * bucket_s, "ok": 0, "shed": 0, "error": 0,
+            "native": 0, "sheds": defaultdict(int), "events": []})
+
+    for rec in access:
+        if "unix_ms" not in rec:
+            continue
+        row = at(rec["unix_ms"])
+        outcome = rec.get("outcome", "error")
+        row[outcome if outcome in ("ok", "shed", "error") else "error"] += 1
+        if rec.get("shed"):
+            row["sheds"][rec["shed"]] += 1
+    for d in dispatches:
+        if d.get("route") == "native" and "unix_ms" in d:
+            at(d["unix_ms"])["native"] += 1
+    for ev in events:
+        if "unix_ms" not in ev:
+            continue
+        label = ev.get("name", "event")
+        model = (ev.get("args") or {}).get("model")
+        at(ev["unix_ms"])["events"].append(
+            f"{label}({model})" if model else label)
+    out = []
+    for k in sorted(rows):
+        row = rows[k]
+        row["sheds"] = dict(row["sheds"])
+        out.append(row)
+    return out
+
+
+def format_serve_report(summary: Dict[str, Any],
+                        timeline: List[Dict[str, Any]],
+                        exemplars: List[Dict[str, Any]],
+                        top: int = 8) -> str:
+    o = summary["outcomes"]
+    shed_detail = ",".join(f"{k}={v}" for k, v in
+                           sorted(summary["shed_reasons"].items()))
+    lines = [
+        f"serve-report: {summary['requests']} request(s) — "
+        f"ok={o.get('ok', 0)} shed={o.get('shed', 0)}"
+        + (f" ({shed_detail})" if shed_detail else "")
+        + f" error={o.get('error', 0)}",
+        f"dispatches: {summary['dispatches']} "
+        f"({summary['dispatched_rows']} rows, coalescing "
+        f"{summary['coalesce_ratio']:.2f} req/dispatch, "
+        f"{summary['cache_misses']} program-cache misses); routes: "
+        + (" ".join(f"{k}={v}" for k, v in
+                    sorted(summary["routes"].items())) or "none"),
+    ]
+    if summary["models"]:
+        lines.append("")
+        lines.append("per-model latency (access log, completed requests):")
+        lines.append(f"  {'model':<18} {'n':>6} {'ok':>6} {'p50':>10} "
+                     f"{'p99':>10} {'max':>10} {'queue p99':>10} "
+                     f"{'disp p99':>10}")
+        for model, m in summary["models"].items():
+            lines.append(
+                f"  {model:<18} {m['requests']:>6} {m['ok']:>6} "
+                f"{m['total_p50_s'] * 1e3:>8.2f}ms "
+                f"{m['total_p99_s'] * 1e3:>8.2f}ms "
+                f"{m['total_max_s'] * 1e3:>8.2f}ms "
+                f"{m['queue_wait_p99_s'] * 1e3:>8.2f}ms "
+                f"{m['dispatch_p99_s'] * 1e3:>8.2f}ms")
+    if timeline:
+        lines.append("")
+        lines.append("shed/degrade timeline (1s buckets):")
+        for row in timeline:
+            sheds = "".join(f" shed[{k}]={v}"
+                            for k, v in sorted(row["sheds"].items()))
+            evs = ("  | " + ", ".join(row["events"])) if row["events"] \
+                else ""
+            lines.append(
+                f"  t+{row['t_s']:>4.0f}s ok={row['ok']:<5} "
+                f"shed={row['shed']:<4} err={row['error']:<4} "
+                f"native={row['native']:<4}{sheds}{evs}")
+    if exemplars:
+        lines.append("")
+        lines.append(f"worst-request exemplars (top {min(top, len(exemplars))} "
+                     "by total time):")
+        lines.append(f"  {'id':<16} {'model':<14} {'rows':>5} {'total':>10} "
+                     f"{'queue':>9} {'batch':>9} {'disp':>9}  outcome")
+        for rec in exemplars[:top]:
+            lines.append(
+                f"  {str(rec.get('id', '?')):<16} "
+                f"{rec.get('model', '?'):<14} {rec.get('rows', 0):>5} "
+                f"{rec.get('total_s', 0) * 1e3:>8.2f}ms "
+                f"{rec.get('queue_wait_s', 0) * 1e3:>7.2f}ms "
+                f"{rec.get('batch_wait_s', 0) * 1e3:>7.2f}ms "
+                f"{rec.get('dispatch_s', 0) * 1e3:>7.2f}ms  "
+                f"{rec.get('outcome', '?')}"
+                + (f" ({rec['shed']})" if rec.get("shed") else ""))
+    return "\n".join(lines)
+
+
+def main(argv: List[str]) -> int:
+    usage = ("usage: python -m xgboost_tpu serve-report <dir> [--top N]")
+    if not argv or argv[0] in ("-h", "--help"):
+        print(usage, file=sys.stderr)
+        return 0 if argv else 1
+    top = 8
+    if "--top" in argv:
+        i = argv.index("--top")
+        try:
+            top = int(argv[i + 1])
+        except (IndexError, ValueError):
+            print(usage, file=sys.stderr)
+            return 1
+        argv = argv[:i] + argv[i + 2:]
+    loaded = load_server_obs(argv[0])
+    if loaded is None:
+        print(f"{argv[0]}: no serving observability found (launch the "
+              "server with run_dir= / --run-dir / XGBTPU_SERVE_DIR — "
+              "docs/serving.md \"Tracing a request\")", file=sys.stderr)
+        return 1
+    obs, access = loaded
+    for err in obs.errors:
+        print(f"serve-report: {err}", file=sys.stderr)
+    events = [r for r in obs.flight if r.get("t") == "event"]
+    dispatches = [r for r in obs.flight if r.get("t") == "dispatch"]
+    summary = summarize_access(access, dispatches)
+    timeline = _timeline(access, events, dispatches)
+    exemplars = sorted((r for r in access if "total_s" in r),
+                       key=lambda r: -r["total_s"])
+    print(format_serve_report(summary, timeline, exemplars, top=top))
+
+    obs_dir = os.path.dirname(obs.path)
+    trace_out = os.path.join(obs_dir, "serve.trace.json")
+    report_out = os.path.join(obs_dir, "serve_report.json")
+    try:
+        fleet.write_trace(trace_out, fleet.merge_trace([obs]))
+        with open(report_out, "w") as f:
+            json.dump({"summary": summary, "timeline": timeline,
+                       "exemplars": exemplars[:top]}, f, default=str)
+    except OSError as e:
+        print(f"serve-report: cannot write outputs: {e}", file=sys.stderr)
+        return 1
+    n_spans = len(obs.trace_events)
+    print(f"\nmerged trace -> {trace_out} ({n_spans} span events)")
+    print(f"summary -> {report_out}")
+    return 0

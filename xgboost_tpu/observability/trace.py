@@ -45,8 +45,9 @@ import time
 from typing import Any, Dict, List, Optional
 
 __all__ = [
-    "span", "instant", "emit", "enabled", "trace_path", "flush", "reset",
-    "load_trace", "clock_base", "set_sink",
+    "span", "instant", "emit", "emit_async", "emit_async_track",
+    "enabled", "trace_path", "flush", "reset", "load_trace",
+    "clock_base", "set_sink",
 ]
 
 _ENV_PATH = "XGBTPU_TRACE"
@@ -208,10 +209,13 @@ def span(name: str, **args: Any):
     return _Span(name, args)
 
 
-def emit(name: str, start_ns: int, end_ns: int, **args: Any) -> None:
+def emit(name: str, start_ns: int, end_ns: int, cat: Optional[str] = None,
+         **args: Any) -> None:
     """Record a complete event from a pre-measured ``perf_counter_ns``
     interval — for instrumentation that already owns its clock reads
-    (``utils.timer.Monitor``)."""
+    (``utils.timer.Monitor``). ``cat`` becomes the Chrome category
+    (``trace-report`` groups span time by it: serving vs train vs
+    collective)."""
     if not enabled() or not _host_side():
         return
     ev = {
@@ -221,9 +225,67 @@ def emit(name: str, start_ns: int, end_ns: int, **args: Any) -> None:
         "dur": max((end_ns - start_ns) // 1000, 1),
         "tid": _tid(),
     }
+    if cat:
+        ev["cat"] = cat
     if args:
         ev["args"] = args
     _record(ev)
+
+
+def emit_async(name: str, track: str, start_ns: int, end_ns: int,
+               cat: str = "serving", **args: Any) -> None:
+    """Record one nestable-async span (Chrome phases 'b'/'e') on the
+    track keyed ``(cat, track)`` — Perfetto renders every event sharing
+    that key as one async lane, so a serving request's whole lifetime
+    (queue -> batch wait -> dispatch) reads as a single track regardless
+    of which thread touched it. Timestamps are pre-measured
+    ``perf_counter_ns`` values (the serving layer stamps stages as they
+    happen but emits only at completion, off the hot path)."""
+    emit_async_track(track, [(name, start_ns, end_ns, args or None)],
+                     cat=cat)
+
+
+def emit_async_track(track: str,
+                     spans: List[tuple],
+                     cat: str = "serving") -> None:
+    """Batched :func:`emit_async`: every ``(name, start_ns, end_ns,
+    args-or-None)`` in ``spans`` lands on the ``(cat, track)`` async lane
+    with ONE enabled check and one buffer lock acquisition. The serving
+    recorder emits a request's whole track (request + queue_wait +
+    batch_wait + dispatch) per completion, so per-event overhead is what
+    the ≤2% serving pin actually measures."""
+    if not spans or not enabled() or not _host_side():
+        return
+    tid = _tid()
+    sid = str(track)
+    epoch = _EPOCH_NS
+    events: List[Dict[str, Any]] = []
+    push = events.append
+    for name, start_ns, end_ns, args in spans:
+        ts0 = (start_ns - epoch) // 1000
+        ts1 = (end_ns - epoch) // 1000
+        begin: Dict[str, Any] = {"name": name, "ph": "b", "cat": cat,
+                                 "id": sid, "ts": ts0, "tid": tid}
+        if args:
+            begin["args"] = args
+        push(begin)
+        push({"name": name, "ph": "e", "cat": cat, "id": sid,
+              "ts": ts1 if ts1 > ts0 else ts0 + 1, "tid": tid})
+    global _dropped
+    dropped = 0
+    with _lock:
+        for ev in events:
+            if len(_buffer) == _buffer.maxlen:
+                dropped += 1
+            _buffer.append(ev)
+        _dropped += dropped
+    if dropped:
+        from .metrics import REGISTRY
+
+        REGISTRY.counter(
+            "trace_events_dropped_total",
+            "Trace events evicted from the ring buffer before flush",
+        ).inc(dropped)
 
 
 def instant(name: str, **args: Any) -> None:

@@ -53,7 +53,7 @@ from ..observability import REGISTRY as _REGISTRY
 from . import StackedForest, _predict_margin_impl, predict_margin
 
 __all__ = ["bucket_rows", "ServingCache", "SERVING_CACHE", "predict_serving",
-           "serving_context"]
+           "serving_context", "last_route"]
 
 _POW2_CAP = 8192  # largest power-of-two bucket
 _BIG_STEP = 8192  # above the cap: round up to a multiple of this
@@ -290,15 +290,38 @@ def serving_context(model: str = "", force_native: bool = False
     degrade route: the request walks the native CPU SoA forest even on a
     device backend (the device path is DEGRADED — see
     ``serving/admission.py`` / docs/resilience.md). Contexts nest; the
-    innermost wins."""
+    innermost wins. Entering clears :func:`last_route` (exiting
+    deliberately does NOT restore it) so a dispatch that never reaches
+    ``predict_serving`` — e.g. a gblinear booster falling back to the
+    DMatrix predict path — reads as ``""`` afterwards instead of the
+    previous dispatch's stale route."""
     prev = (getattr(_SERVING_TLS, "model", ""),
             getattr(_SERVING_TLS, "force_native", False))
     _SERVING_TLS.model = model
     _SERVING_TLS.force_native = force_native
+    _SERVING_TLS.route = ""
     try:
         yield
     finally:
         _SERVING_TLS.model, _SERVING_TLS.force_native = prev
+
+
+def last_route() -> str:
+    """Which route the most recent ``predict_serving`` call on THIS
+    thread took: ``native`` (CPU SoA walker), ``pallas`` (shared pallas
+    dispatcher), ``xla`` (bucketed compiled program) or ``base`` (no
+    trees). The model server's dispatch loop reads this right after a
+    coalesced dispatch to stamp the route onto the request records and
+    the dispatch flight ring (ISSUE 9) — thread-local, so concurrent
+    servers/tests never see each other's routes. Empty string before the
+    first call on a thread, and after a ``serving_context`` dispatch
+    that bypassed ``predict_serving`` entirely."""
+    return getattr(_SERVING_TLS, "route", "")
+
+
+def _note_route(route: str) -> str:
+    _SERVING_TLS.route = route
+    return route
 
 
 def _device_route_degraded() -> bool:
@@ -479,6 +502,7 @@ def _predict_serving_impl(
         "inplace_predict_rows_total",
         "Rows served through the inplace/serving fast path").inc(n)
     if forest.left.shape[0] == 0:  # no trees: margins are the base alone
+        _note_route("base")
         out = np.asarray(base, np.float32)
         if transform is not None:
             out = _transform_bucketed(out, transform, K)
@@ -488,6 +512,7 @@ def _predict_serving_impl(
         margin = _native_margin(forest, X.csr if sparse else X, base,
                                 tree_weights)
         if margin is not None:
+            _note_route("native")
             if transform is None:
                 return margin
             return _transform_bucketed(margin, transform, K)
@@ -530,8 +555,10 @@ def _predict_serving_impl(
             return run_shared
 
         prog = cache.program(key + ("pallas",), build)
+        _note_route("pallas")
         return np.asarray(prog(forest, Xp, bp, tw))[:n]
 
+    _note_route("xla")
     prog = cache.program(key, functools.partial(
         _build_program, forest.n_groups, forest.max_depth, forest.has_cats,
         transform))

@@ -14,21 +14,28 @@ the serving-side analog of the reference's bindings/frontends tier
 - :mod:`~xgboost_tpu.serving.swap` — zero-downtime hot swap: load → warm
   → atomic pointer flip → drain the old snapshot;
 - :mod:`~xgboost_tpu.serving.admission` — SLO-aware admission: deadline /
-  queue-depth / p99 shed decisions, degrade-machine routing to the native
-  CPU walker.
+  queue-depth / per-model-p99 shed decisions, degrade-machine routing to
+  the native CPU walker;
+- :mod:`~xgboost_tpu.serving.obs` — request-scope observability (ISSUE
+  9): per-request ids/traces/access log, the per-dispatch flight ring,
+  and the SLO ledger (stage histograms, error-budget burn, exemplars)
+  feeding ``python -m xgboost_tpu serve-report``.
 
 Entry points: :class:`ModelServer` (``xgb.ModelServer``) in Python,
 ``python -m xgboost_tpu serve`` for the JSONL stdin/socket protocol.
-Full walkthrough: docs/serving.md ("The model server").
+Full walkthrough: docs/serving.md ("The model server", "Tracing a
+request").
 """
 
 from .admission import AdmissionController, RequestShed  # noqa: F401
 from .batcher import MicroBatcher  # noqa: F401
+from .obs import ServingRecorder, SLOLedger  # noqa: F401
 from .server import ModelServer, serve_main  # noqa: F401
 from .swap import hot_swap  # noqa: F401
 from .tenancy import ModelEntry, ModelRegistry  # noqa: F401
 
 __all__ = [
     "AdmissionController", "MicroBatcher", "ModelEntry", "ModelRegistry",
-    "ModelServer", "RequestShed", "hot_swap", "serve_main",
+    "ModelServer", "RequestShed", "SLOLedger", "ServingRecorder",
+    "hot_swap", "serve_main",
 ]

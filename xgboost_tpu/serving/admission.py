@@ -91,17 +91,29 @@ class AdmissionController:
         self._degraded_routes.inc(0)
 
     # ------------------------------------------------------------------
-    def p99_s(self) -> float:
-        """Current p99 of the process-wide serving latency series, with
-        the cold-start prior when nothing was observed yet."""
+    def p99_s(self, model: str = "") -> float:
+        """Current p99 of the serving latency series. With a ``model``
+        label (``name@vN``), the per-model child of
+        ``predict_latency_seconds`` wins whenever it has samples — a slow
+        tenant must not be judged by a fast fleet-wide tail (nor the
+        reverse); a cold model (no labelled samples yet) falls back to
+        the unlabelled process-wide aggregate, and a cold server to the
+        prior."""
+        if model:
+            q = REGISTRY.quantile("predict_latency_seconds", 0.99,
+                                  model=model)
+            if q is not None:
+                return max(q, 1e-6)
         q = REGISTRY.quantile("predict_latency_seconds", 0.99)
         return _COLD_P99_S if q is None else max(q, 1e-6)
 
     def admit(self, queue_depth: int,
-              deadline: Optional[float] = None) -> None:
+              deadline: Optional[float] = None,
+              model: str = "") -> None:
         """Raise :class:`RequestShed` if the request should not enter the
         queue; record the admission otherwise. ``deadline`` is an absolute
-        ``time.monotonic()`` instant (None = no SLO)."""
+        ``time.monotonic()`` instant (None = no SLO); ``model`` scopes
+        the p99 estimate to the tenant being requested."""
         if queue_depth >= self.max_queue:
             self._shed.labels(reason=QUEUE_FULL).inc()
             raise RequestShed(
@@ -113,13 +125,15 @@ class AdmissionController:
                 raise RequestShed(DEADLINE, "deadline already past at admit")
             # projected completion: everything ahead of us plus our own
             # dispatch, each at the observed tail latency
-            eta = (queue_depth + 1) * self.p99_s()
+            p99 = self.p99_s(model)
+            eta = (queue_depth + 1) * p99
             if now + eta > deadline:
                 self._shed.labels(reason=SLO).inc()
                 raise RequestShed(
                     SLO, f"projected wait {eta * 1e3:.1f}ms past deadline "
                          f"(queue depth {queue_depth}, "
-                         f"p99 {self.p99_s() * 1e3:.2f}ms)")
+                         f"p99 {p99 * 1e3:.2f}ms"
+                         + (f" for {model}" if model else "") + ")")
         self._admitted.inc()
 
     def shed_at_dispatch(self, reason: str = DEADLINE) -> RequestShed:

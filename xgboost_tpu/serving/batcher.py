@@ -38,7 +38,8 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from ..observability.metrics import REGISTRY
-from .admission import AdmissionController
+from .admission import AdmissionController, RequestShed
+from .obs import RequestRecord, ServingRecorder
 from .tenancy import ModelEntry
 
 __all__ = ["MicroBatcher"]
@@ -56,11 +57,12 @@ def _env_int(name: str, default: int) -> int:
 class _Request:
     __slots__ = ("entry", "X", "n", "group_key", "predict_type",
                  "iteration_range", "missing", "base_margin", "deadline",
-                 "future")
+                 "future", "rec")
 
     def __init__(self, entry: ModelEntry, X, n: int, group_key: Tuple,
                  predict_type: str, iteration_range, missing, base_margin,
-                 deadline: Optional[float]) -> None:
+                 deadline: Optional[float],
+                 rec: Optional[RequestRecord]) -> None:
         self.entry = entry
         self.X = X
         self.n = n
@@ -70,7 +72,12 @@ class _Request:
         self.missing = missing
         self.base_margin = base_margin
         self.deadline = deadline
+        self.rec = rec
         self.future: "Future" = Future()
+        if rec is not None:
+            # the response side of request tracing: every future carries
+            # the id its access-log line and trace track were written under
+            self.future.request_id = rec.id
 
 
 class MicroBatcher:
@@ -79,9 +86,11 @@ class MicroBatcher:
     delegated to the attached :class:`AdmissionController`."""
 
     def __init__(self, admission: Optional[AdmissionController] = None,
-                 *, max_wait_us: Optional[int] = None,
+                 *, obs: Optional[ServingRecorder] = None,
+                 max_wait_us: Optional[int] = None,
                  max_batch_rows: Optional[int] = None) -> None:
         self.admission = admission or AdmissionController()
+        self.obs = obs
         if max_wait_us is None:
             max_wait_us = _env_int("XGBTPU_BATCH_WAIT_US", 1000)
         if max_batch_rows is None:
@@ -112,11 +121,34 @@ class MicroBatcher:
     def submit(self, entry: ModelEntry, data, *,
                predict_type: str = "value", iteration_range=None,
                missing: float = np.nan, base_margin=None,
-               deadline: Optional[float] = None) -> "Future":
+               deadline: Optional[float] = None,
+               rec: Optional[RequestRecord] = None) -> "Future":
         """Enqueue one predict request against a pinned model entry.
         Returns a Future resolving to the prediction array (rows in input
         order), or raising :class:`~xgboost_tpu.serving.RequestShed` /
-        the dispatch error. ``deadline`` is absolute ``time.monotonic()``."""
+        the dispatch error. ``deadline`` is absolute ``time.monotonic()``;
+        ``rec`` is the server's request-trace record — sealed here on a
+        shed/refusal, by the dispatch path otherwise."""
+        try:
+            return self._submit(entry, data, predict_type=predict_type,
+                                iteration_range=iteration_range,
+                                missing=missing, base_margin=base_margin,
+                                deadline=deadline, rec=rec)
+        except BaseException as e:
+            if self.obs is not None and rec is not None:
+                if isinstance(e, RequestShed):
+                    self.obs.finish(rec, "shed", shed_reason=e.reason)
+                else:
+                    self.obs.finish(rec, "error",
+                                    error=f"{type(e).__name__}: {e}")
+                # sheds never produce a future, so the id rides the
+                # exception — shed responses still carry their request_id
+                e.request_id = rec.id
+            raise
+
+    def _submit(self, entry: ModelEntry, data, *, predict_type,
+                iteration_range, missing, base_margin, deadline,
+                rec: Optional[RequestRecord]) -> "Future":
         if iteration_range is not None \
                 and tuple(iteration_range) == (0, 0):
             iteration_range = None
@@ -133,13 +165,16 @@ class MicroBatcher:
             missing = np.nan  # sentinel already folded into NaN
             coalescible = base_margin is None
         n = X.shape[0]
+        if rec is not None:
+            rec.rows = int(n)
         rkey = None if iteration_range is None else tuple(iteration_range)
         with self._lock:
             if self._closed:
                 raise RuntimeError("model server is closed")
             # qsize is exact under the lock only for submitters; the
             # worker draining concurrently just makes admission lenient
-            self.admission.admit(self._q.qsize(), deadline)
+            self.admission.admit(self._q.qsize(), deadline,
+                                 model=entry.label)
             req = _Request(
                 entry, X, n,
                 # sparse / base-margin requests get an identity key: they
@@ -147,7 +182,7 @@ class MicroBatcher:
                 (id(entry), predict_type, rkey, X.shape[1])
                 if coalescible else (object(),),
                 predict_type, iteration_range, missing, base_margin,
-                deadline)
+                deadline, rec)
             entry.acquire()
             self._q.put(req)
             self._depth.set(self._q.qsize())
@@ -159,6 +194,8 @@ class MicroBatcher:
             item = self._q.get()
             if item is _STOP:
                 break
+            if item.rec is not None:
+                item.rec.mark_dequeued()
             batch = [item]
             rows = item.n
             window_end = time.monotonic() + self.max_wait_s
@@ -172,6 +209,8 @@ class MicroBatcher:
                 if nxt is _STOP:
                     self._q.put(_STOP)  # re-arm: exit after this batch
                     break
+                if nxt.rec is not None:
+                    nxt.rec.mark_dequeued()
                 batch.append(nxt)
                 rows += nxt.n
             self._depth.set(self._q.qsize())
@@ -191,7 +230,12 @@ class MicroBatcher:
 
     def _dispatch_group(self, grp: List[_Request],
                         force_native: bool) -> None:
+        from ..predictor.serving import bucket_rows, last_route
+
         first = grp[0]
+        rows = sum(r.n for r in grp)
+        h0, m0 = self._cache_counts()
+        t0 = time.perf_counter_ns()
         try:
             if len(grp) == 1:
                 X = first.X
@@ -204,11 +248,30 @@ class MicroBatcher:
                 force_native=force_native)
             self._dispatches.inc()
             self._batched.inc(len(grp))
-            self._rows.inc(sum(r.n for r in grp))
+            self._rows.inc(rows)
         except BaseException as e:  # noqa: BLE001 — worker must survive
             for req in grp:
                 self._resolve_err(req, e)
             return
+        t1 = time.perf_counter_ns()
+        route = last_route()  # this thread ran the dispatch: exact
+        bucket = bucket_rows(rows)
+        h1, m1 = self._cache_counts()
+        recs = [r.rec for r in grp if r.rec is not None]
+        for req in grp:
+            if req.rec is not None:
+                req.rec.t_dispatch0 = t0
+                req.rec.t_dispatch1 = t1
+                req.rec.route = route
+                req.rec.bucket = bucket
+                req.rec.coalesced = len(grp)
+        if self.obs is not None:
+            self.obs.dispatch(
+                recs, model=first.entry.label, rows=rows, bucket=bucket,
+                route=route, cache_hits=h1 - h0, cache_misses=m1 - m0,
+                queue_depth=self._q.qsize(), t0_ns=t0, t1_ns=t1)
+            for rec in recs:
+                self.obs.finish(rec, "ok")
         off = 0
         for req in grp:
             req.entry.release()
@@ -216,8 +279,25 @@ class MicroBatcher:
             off += req.n
 
     @staticmethod
-    def _resolve_err(req: _Request, exc: BaseException) -> None:
+    def _cache_counts() -> Tuple[float, float]:
+        """Bucketed-program-cache hit/miss totals; the single worker
+        thread reads deltas around its own dispatch, so concurrent
+        non-serving predicts can only over-count, never corrupt."""
+        out = []
+        for name in ("predict_bucket_cache_hits_total",
+                     "predict_bucket_cache_misses_total"):
+            fam = REGISTRY.get(name)
+            out.append(0.0 if fam is None else fam.labels().value)
+        return out[0], out[1]
+
+    def _resolve_err(self, req: _Request, exc: BaseException) -> None:
         req.entry.release()
+        if self.obs is not None and req.rec is not None:
+            if isinstance(exc, RequestShed):
+                self.obs.finish(req.rec, "shed", shed_reason=exc.reason)
+            else:
+                self.obs.finish(req.rec, "error",
+                                error=f"{type(exc).__name__}: {exc}")
         req.future.set_exception(exc)
 
     # ------------------------------------------------------------------

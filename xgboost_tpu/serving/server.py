@@ -33,6 +33,7 @@ import numpy as np
 from ..observability.metrics import REGISTRY
 from .admission import AdmissionController, RequestShed
 from .batcher import MicroBatcher
+from .obs import ServingRecorder
 from .swap import SwapRunner, warm_entry
 from .tenancy import ModelRegistry
 
@@ -45,7 +46,11 @@ class ModelServer:
     Construction knobs mirror the env vars so embedded use never needs
     ``os.environ`` games: ``arena_mb`` (XGBTPU_SERVING_ARENA_MB),
     ``max_queue`` (XGBTPU_SERVING_QUEUE), ``batch_wait_us``
-    (XGBTPU_BATCH_WAIT_US), ``max_batch_rows`` (XGBTPU_BATCH_MAX_ROWS).
+    (XGBTPU_BATCH_WAIT_US), ``max_batch_rows`` (XGBTPU_BATCH_MAX_ROWS),
+    ``run_dir`` (XGBTPU_SERVE_DIR — the durable observability sink:
+    access log, dispatch flight ring and request trace under
+    ``run_dir/obs/server/``, the ``python -m xgboost_tpu serve-report``
+    input set; docs/serving.md "Tracing a request").
     ``models`` maps name -> source (model JSON path/bytes, live Booster,
     or PR-4 checkpoint file/directory)."""
 
@@ -53,13 +58,15 @@ class ModelServer:
                  arena_mb: Optional[float] = None,
                  max_queue: Optional[int] = None,
                  batch_wait_us: Optional[int] = None,
-                 max_batch_rows: Optional[int] = None) -> None:
-        self.registry = ModelRegistry(arena_mb)
+                 max_batch_rows: Optional[int] = None,
+                 run_dir: Optional[str] = None) -> None:
+        self.obs = ServingRecorder(run_dir)
+        self.registry = ModelRegistry(arena_mb, on_event=self.obs.event)
         self.admission = AdmissionController(max_queue)
         self.batcher = MicroBatcher(
-            self.admission, max_wait_us=batch_wait_us,
+            self.admission, obs=self.obs, max_wait_us=batch_wait_us,
             max_batch_rows=max_batch_rows)
-        self._swapper = SwapRunner(self.registry)
+        self._swapper = SwapRunner(self.registry, on_event=self.obs.event)
         self._closed = False
         if models:
             for name, source in models.items():
@@ -74,6 +81,7 @@ class ModelServer:
                                    booster=booster)
         if warm:
             warm_entry(entry)
+        self.obs.event("model_load", model=entry.label)
         return entry.label
 
     def swap(self, name: str, source: Any, *,
@@ -96,21 +104,33 @@ class ModelServer:
                       deadline_ms: Optional[float] = None,
                       version: Optional[int] = None,
                       predict_type: str = "value", iteration_range=None,
-                      missing: float = np.nan,
-                      base_margin=None) -> "Future":
+                      missing: float = np.nan, base_margin=None,
+                      request_id: Optional[str] = None) -> "Future":
         """Admit + enqueue one request; the Future resolves to the
-        prediction (or raises :class:`RequestShed` / the dispatch error)."""
+        prediction (or raises :class:`RequestShed` / the dispatch error)
+        and carries ``.request_id`` — the caller-supplied id or a
+        generated one — under which the request's access-log line and
+        trace track were written (docs/serving.md "Tracing a request")."""
         import time
 
         if self._closed:
             raise RuntimeError("model server is closed")
+        rec = self.obs.start_request(request_id, deadline_ms)
         deadline = (time.monotonic() + deadline_ms / 1e3
                     if deadline_ms is not None else None)
-        entry = self.registry.get(name, version)
+        try:
+            entry = self.registry.get(name, version)
+        except KeyError as e:
+            # unknown model: still one access-log line per request
+            rec.model = name
+            self.obs.finish(rec, "error", error=f"KeyError: {e}")
+            e.request_id = rec.id
+            raise
+        rec.model = entry.label
         return self.batcher.submit(
             entry, data, predict_type=predict_type,
             iteration_range=iteration_range, missing=missing,
-            base_margin=base_margin, deadline=deadline)
+            base_margin=base_margin, deadline=deadline, rec=rec)
 
     def predict(self, name: str, data, *,
                 timeout: Optional[float] = 60.0, **kw) -> np.ndarray:
@@ -122,16 +142,26 @@ class ModelServer:
         return REGISTRY.exposition()
 
     def stats(self) -> Dict[str, Any]:
+        """Operational snapshot for the ``stats`` op: arena + queue state
+        plus the SLO ledger (stage-histogram p50/p99 overall and per
+        model, deadline hit/miss, current error-budget burn, worst
+        exemplars) — the JSONL protocol's view of the ledger without
+        scraping ``metrics``."""
+        self.obs.drain()  # barrier: include every completed request
         return {
             "arena": self.registry.stats(),
             "queue_depth": self.batcher.queue_depth(),
             "p99_s": self.admission.p99_s(),
+            "slo": self.obs.ledger.summary(),
         }
 
     def close(self, drain: bool = True) -> None:
         if not self._closed:
             self._closed = True
             self.batcher.close(drain=drain)
+            # seal the flight recorder last: the black box carries the
+            # final SLO summary and every drained request's access line
+            self.obs.close()
 
     def __enter__(self) -> "ModelServer":
         return self
@@ -157,15 +187,20 @@ def _handle(server: ModelServer, msg: Dict[str, Any],
             data = np.asarray(msg["data"], np.float32)
             if data.ndim == 1:  # single-row convenience
                 data = data.reshape(1, -1)
-            result = server.predict(
+            # the protocol's message id doubles as the request-trace id,
+            # so a client log line and the server's access-log line /
+            # trace track correlate without translation
+            fut = server.predict_async(
                 msg.get("model", "default"), data,
                 deadline_ms=msg.get("deadline_ms"),
+                request_id=None if rid is None else str(rid),
                 predict_type=("margin" if msg.get("margin")
                               else "value"),
                 iteration_range=(tuple(msg["iteration_range"])
                                  if msg.get("iteration_range") else None),
-                missing=float(msg.get("missing", "nan")),
-                timeout=msg.get("timeout_s", 60.0))
+                missing=float(msg.get("missing", "nan")))
+            out["request_id"] = getattr(fut, "request_id", None)
+            result = fut.result(msg.get("timeout_s", 60.0))
             out["result"] = np.asarray(result, np.float64).tolist()
         elif op == "load":
             out["version"] = server.load(
@@ -187,8 +222,12 @@ def _handle(server: ModelServer, msg: Dict[str, Any],
     except RequestShed as e:
         out["error"] = str(e)
         out["shed"] = e.reason
+        if getattr(e, "request_id", None) is not None:
+            out.setdefault("request_id", e.request_id)
     except Exception as e:  # noqa: BLE001 — protocol surface: report, don't die
         out["error"] = f"{type(e).__name__}: {e}"
+        if getattr(e, "request_id", None) is not None:
+            out.setdefault("request_id", e.request_id)
     return out
 
 
@@ -197,7 +236,8 @@ def _parse_serve_args(argv: List[str]) -> Dict[str, Any]:
                             "host": "127.0.0.1"}
     flags = {"--port": ("port", int), "--arena-mb": ("arena_mb", float),
              "--batch-wait-us": ("batch_wait_us", int),
-             "--max-queue": ("max_queue", int), "--host": ("host", str)}
+             "--max-queue": ("max_queue", int), "--host": ("host", str),
+             "--run-dir": ("run_dir", str)}
     i = 0
     while i < len(argv):
         a = argv[i]
@@ -233,14 +273,15 @@ def serve_main(argv: List[str], stdin=None, stdout=None) -> int:
         print(f"serve: {e}", file=sys.stderr)
         print("usage: python -m xgboost_tpu serve (--port N | --stdin) "
               "[--model name=path ...] [--arena-mb M] [--batch-wait-us U] "
-              "[--max-queue Q] [--host H]", file=sys.stderr)
+              "[--max-queue Q] [--host H] [--run-dir D]", file=sys.stderr)
         return 1
     stdin = stdin if stdin is not None else sys.stdin
     stdout = stdout if stdout is not None else sys.stdout
     server = ModelServer(
         opts["models"], arena_mb=opts.get("arena_mb"),
         max_queue=opts.get("max_queue"),
-        batch_wait_us=opts.get("batch_wait_us"))
+        batch_wait_us=opts.get("batch_wait_us"),
+        run_dir=opts.get("run_dir"))
 
     def respond(obj: Dict[str, Any], fh) -> None:
         fh.write(json.dumps(obj) + "\n")

@@ -49,13 +49,16 @@ def warm_entry(entry: ModelEntry) -> None:
 def hot_swap(registry: ModelRegistry, name: str, source: Any, *,
              version: Optional[int] = None, booster=None,
              warm: bool = True, drain_timeout_s: float = 60.0,
-             on_flip=None) -> ModelEntry:
+             on_flip=None, on_event=None) -> ModelEntry:
     """Swap ``name``'s live version for one loaded from ``source``.
     Returns the new live entry after the old snapshot drained (or the
     timeout passed — the old entry is left to drain under its in-flight
     pins either way; memory is only reclaimed once they release).
     ``on_flip`` (used by the server) runs right after the pointer flip,
-    before draining."""
+    before draining; ``on_event(name, **args)`` (the serving flight
+    recorder's hook) records the completed swap on the request timeline
+    — from here rather than the server, so background ``swap_async``
+    flips land on the timeline too."""
     old_version = registry.live_version(name)
     entry = registry.load(name, source, version=version, booster=booster,
                           make_live=False)
@@ -80,16 +83,21 @@ def hot_swap(registry: ModelRegistry, name: str, source: Any, *,
         "model_swaps_total",
         "Completed zero-downtime model swaps").labels(
             model=entry.label).inc()
+    if on_event is not None:
+        on_event("model_swap", model=entry.label,
+                 old_version=old_version)
     return entry
 
 
 class SwapRunner:
     """Background-thread wrapper so a CLI/server can swap mid-traffic
     without stalling its request loop; at most one swap per model at a
-    time (a second request for the same name waits its turn)."""
+    time (a second request for the same name waits its turn).
+    ``on_event`` is forwarded to every :func:`hot_swap`."""
 
-    def __init__(self, registry: ModelRegistry) -> None:
+    def __init__(self, registry: ModelRegistry, on_event=None) -> None:
         self._registry = registry
+        self._on_event = on_event
         self._locks: dict = {}
         self._guard = threading.Lock()
 
@@ -102,6 +110,7 @@ class SwapRunner:
 
     def swap(self, name: str, source: Any, **kw) -> ModelEntry:
         with self._model_lock(name):
+            kw.setdefault("on_event", self._on_event)
             return hot_swap(self._registry, name, source, **kw)
 
     def swap_async(self, name: str, source: Any, **kw) -> threading.Thread:

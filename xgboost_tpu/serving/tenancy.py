@@ -177,10 +177,15 @@ class ModelRegistry:
     replaced stay alive (and addressable by explicit version) until
     evicted or released."""
 
-    def __init__(self, arena_mb: Optional[float] = None) -> None:
+    def __init__(self, arena_mb: Optional[float] = None,
+                 on_event=None) -> None:
         if arena_mb is None:
             arena_mb = _env_float("XGBTPU_SERVING_ARENA_MB", 512.0)
         self.budget_bytes = max(1, int(arena_mb * 1024 * 1024))
+        # serving flight-recorder hook (``obs.ServingRecorder.event``):
+        # evictions and fault-back-ins are timeline events an operator
+        # reading serve-report needs next to the latency cliff they cause
+        self._on_event = on_event or (lambda name, **args: None)
         self._lock = threading.RLock()
         self._entries: "OrderedDict[Tuple[str, int], ModelEntry]" = \
             OrderedDict()
@@ -237,8 +242,10 @@ class ModelRegistry:
                 "serving_model_loads_total",
                 "Models (re)loaded into the arena").labels(
                     model=entry.label).inc()
-            self._evict_to_budget_locked(keep=key)
+            evicted = self._evict_to_budget_locked(keep=key)
             self._publish_locked()
+        for label in evicted:  # file I/O stays off the registry lock
+            self._on_event("model_evict", model=label)
         return entry
 
     def get(self, name: str, version: Optional[int] = None) -> ModelEntry:
@@ -259,6 +266,7 @@ class ModelRegistry:
             if spec is None:
                 raise KeyError(f"unknown model version: {name!r} v{v}")
             self._misses.inc()
+        self._on_event("model_fault_in", model=f"{name}@v{v}")
         # reload outside the lock (may read disk / restack the forest)
         booster = load_booster(spec)
         nbytes = _forest_footprint_bytes(booster) + _spec_bytes(spec)
@@ -269,8 +277,10 @@ class ModelRegistry:
                 self._entries.move_to_end(key)
                 return raced
             self._entries[key] = entry
-            self._evict_to_budget_locked(keep=key)
+            evicted = self._evict_to_budget_locked(keep=key)
             self._publish_locked()
+        for label in evicted:
+            self._on_event("model_evict", model=label)
         return entry
 
     def set_live(self, name: str, version: int) -> ModelEntry:
@@ -329,16 +339,18 @@ class ModelRegistry:
             }
 
     # ------------------------------------------------------------------
-    def _evict_to_budget_locked(self, keep: Tuple[str, int]) -> None:
+    def _evict_to_budget_locked(self, keep: Tuple[str, int]) -> List[str]:
         """Drop least-recently-used entries until under budget. The entry
         being installed is exempt (a model bigger than the whole budget
         still serves — the arena just holds nothing else). In-flight
         entries are skipped this pass: their memory is pinned by the
         requests anyway, and dropping the registry's reference would only
-        hide the bytes from the gauge."""
+        hide the bytes from the gauge. Returns the evicted labels so the
+        caller can emit timeline events after releasing the lock."""
+        evicted: List[str] = []
         total = sum(e.nbytes for e in self._entries.values())
         if total <= self.budget_bytes:
-            return
+            return evicted
         for key in list(self._entries):
             if total <= self.budget_bytes:
                 break
@@ -350,6 +362,8 @@ class ModelRegistry:
             del self._entries[key]
             total -= entry.nbytes
             self._evictions.inc()
+            evicted.append(entry.label)
+        return evicted
 
     def _publish_locked(self) -> None:
         self._g_bytes.set(sum(e.nbytes for e in self._entries.values()))
