@@ -223,20 +223,30 @@ print(f"obs-report OK: {len(merged)} merged events, ranks {sorted(pids)}, "
       "membership instants + elastic rollup + SIGKILL black box present")
 EOF
 
-echo "=== tier 1.7: serving smoke lane (model server CLI + serve-report) ==="
+echo "=== tier 1.7: serving smoke + chaos lane (poison, SIGTERM, manifest) ==="
 # The production model server end to end, the way an operator runs it:
 # start `python -m xgboost_tpu serve` on a TCP port with a v1 model AND
-# a --run-dir observability sink, drive 8 concurrent client connections
-# (so the micro-batcher actually coalesces) sending request_ids — with a
-# seeded subset carrying an already-lapsed deadline so real sheds happen
-# — hot-swap to v2 MID-TRAFFIC, and require zero unexpected failures
-# plus the serving metrics in the exposition. Then the request-scope
-# observability contract (ISSUE 9): one access-log line per request,
-# `serve-report` printing per-model p50/p99 + the shed timeline with the
-# swap on it + the exemplar table, and the per-request spans loadable
-# from the merged Chrome trace (docs/serving.md "Tracing a request").
+# a --run-dir observability sink — with seeded chaos armed: one
+# serving_model_load transient fault (absorbed by the bounded retry) and
+# a poison payload sentinel (XGBTPU_CHAOS_POISON). Drive 8 concurrent
+# client connections (so the micro-batcher actually coalesces) sending
+# request_ids — a seeded subset carries an already-lapsed deadline (real
+# sheds) and exactly ONE request carries the poison value: the isolation
+# ladder must fail exactly that request with a typed error while every
+# co-batched neighbor succeeds (ISSUE 10). Hot-swap to v2 MID-TRAFFIC,
+# require zero unexpected failures, assert the fault/breaker/quarantine
+# series in the exposition, re-send the poison (quarantined at
+# admission), then SIGTERM the server mid-traffic: every admitted
+# request completes, the process exits 0, and a RESTARTED server with
+# only --run-dir re-serves both models lazily from the persisted
+# manifest. Then the request-scope observability contract (ISSUE 9):
+# one access-log line per answered request, `serve-report` printing
+# per-model p50/p99 + the shed timeline with the swap and the drain on
+# it + the exemplar table, and the per-request spans loadable from the
+# merged Chrome trace (docs/serving.md "Tracing a request",
+# "Failure handling").
 python - <<'EOF'
-import io, json, os, socket, subprocess, sys, tempfile, threading, time
+import io, json, os, signal, socket, subprocess, sys, tempfile, threading, time
 from contextlib import redirect_stdout
 
 import numpy as np
@@ -254,32 +264,48 @@ v1 = xgb.train(params, xgb.DMatrix(X, label=y), 3)
 v1_path = os.path.join(tmp, "v1.json"); v1.save_model(v1_path)
 v2 = xgb.train(dict(params, seed=5), xgb.DMatrix(X, label=y), 4)
 v2_path = os.path.join(tmp, "v2.json"); v2.save_model(v2_path)
+POISON = 1e30
+Xp = X[:1].copy(); Xp[0, 2] = POISON
 
 s = socket.socket(); s.bind(("127.0.0.1", 0))
 port = s.getsockname()[1]; s.close()
 env = dict(os.environ)
 env["PYTHONPATH"] = os.getcwd() + os.pathsep + env.get("PYTHONPATH", "")
 env.pop("XGBTPU_TRACE", None)  # request spans go to the run_dir sink
-proc = subprocess.Popen(
-    [sys.executable, "-m", "xgboost_tpu", "serve", "--port", str(port),
-     "--model", f"m={v1_path}", "--batch-wait-us", "2000",
-     "--run-dir", run_dir],
-    env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
-try:
-    ready = proc.stdout.readline()
-    assert ready.startswith("READY"), ready
+# seeded chaos: first model-load attempt fails transiently (the bounded
+# retry absorbs it), and the poison sentinel arms the isolation ladder
+env["XGBTPU_CHAOS"] = "serving_model_load:transient:1"
+env["XGBTPU_CHAOS_POISON"] = str(POISON)
+env["XGBTPU_QUARANTINE_AFTER"] = "1"
 
-    def rpc(sock, obj):
-        sock.sendall((json.dumps(obj) + "\n").encode())
-        buf = b""
-        while not buf.endswith(b"\n"):
-            chunk = sock.recv(1 << 16)
-            assert chunk, "server closed connection mid-response"
-            buf += chunk
-        return json.loads(buf)
+def start_server(extra):
+    p = subprocess.Popen(
+        [sys.executable, "-m", "xgboost_tpu", "serve", "--port", str(port),
+         "--batch-wait-us", "2000", "--run-dir", run_dir] + extra,
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+    ready = p.stdout.readline()
+    assert ready.startswith("READY"), ready
+    return p
+
+def rpc(sock, obj):
+    sock.sendall((json.dumps(obj) + "\n").encode())
+    buf = b""
+    while not buf.endswith(b"\n"):
+        chunk = sock.recv(1 << 16)
+        if not chunk:
+            return None  # EOF (only legal after SIGTERM)
+        buf += chunk
+    return json.loads(buf)
+
+proc = start_server(["--model", f"m={v1_path}"])
+try:
+    ctl = socket.create_connection(("127.0.0.1", port), timeout=120)
+    r = rpc(ctl, {"op": "load", "model": "m2", "path": v1_path})
+    assert r.get("version") == "m2@v1", r  # second tenant for the manifest
 
     N_CLIENTS, PER = 8, 25
-    failures, served, shed = [], [0], [0]
+    failures, served, shed, poisoned = [], [0], [0], []
     def traffic(k):
         c = socket.create_connection(("127.0.0.1", port), timeout=120)
         try:
@@ -288,12 +314,20 @@ try:
                 req = {"op": "predict", "id": f"{k}-{i}", "model": "m",
                        "data": X[lo:lo + 1 + (i % 4)].tolist(),
                        "timeout_s": 120.0}
+                if k == 0 and i == 10:  # THE seeded poison request
+                    req["data"] = Xp.tolist()
                 if i % 12 == 7:  # seeded sheds: deadline already lapsed
                     req["deadline_ms"] = 0
                 r = rpc(c, req)
                 # every response carries the request id it was traced as
                 if r.get("request_id") != f"{k}-{i}":
                     failures.append(("bad request_id echo", r))
+                elif k == 0 and i == 10:
+                    # exactly this request fails, with the typed error
+                    if "RequestError" in r.get("error", ""):
+                        poisoned.append(r)
+                    else:
+                        failures.append(("poison not isolated", r))
                 elif r.get("shed"):
                     shed[0] += 1
                     if i % 12 != 7:
@@ -309,33 +343,75 @@ try:
                for k in range(N_CLIENTS)]
     for t in threads: t.start()
     time.sleep(0.3)  # let traffic build, then swap under it
-    ctl = socket.create_connection(("127.0.0.1", port), timeout=120)
     r = rpc(ctl, {"op": "swap", "model": "m", "path": v2_path})
     assert r.get("version") == "m@v2", r
     for t in threads: t.join()
     assert not failures, f"requests failed across the hot swap: {failures[:3]}"
     total = N_CLIENTS * PER
-    assert served[0] + shed[0] == total, (served, shed)
+    assert len(poisoned) == 1, "the poison request did not fail typed"
+    assert served[0] + shed[0] + 1 == total, (served, shed)
     assert shed[0] >= N_CLIENTS, f"seeded deadline sheds missing: {shed}"
+    # the same poison again: quarantined at admission, not re-bisected
+    r = rpc(ctl, {"op": "predict", "id": "poison-again", "model": "m",
+                  "data": Xp.tolist()})
+    assert r.get("shed") == "quarantine", r
     exp = rpc(ctl, {"op": "metrics"})["metrics"]
     assert 'model_swaps_total{model="m@v2"} 1' in exp, exp[-2000:]
     assert 'requests_shed_total{reason="deadline"}' in exp, exp[-2000:]
     assert "serving_dispatches_total" in exp
     assert "serving_dispatch_seconds" in exp  # SLO ledger histograms live
+    # ISSUE 10: the fault, breaker and quarantine series are all live
+    assert 'serving_faults_total{kind="permanent",site="serving_dispatch"}' \
+        in exp, exp[-2000:]
+    assert 'faults_total{kind="transient",site="serving_model_load"}' in exp
+    assert 'retries_total{site="serving_model_load"}' in exp
+    assert "serving_poison_requests_total 1" in exp
+    assert 'requests_shed_total{reason="quarantine"} 1' in exp
+    assert 'serving_breaker_state{model="m"} 0' in exp  # closed, but live
+    assert "serving_quarantined_inputs 1" in exp
     # stats op exposes the ledger without scraping metrics
-    slo = rpc(ctl, {"op": "stats"})["stats"]["slo"]
+    st = rpc(ctl, {"op": "stats"})["stats"]
+    slo = st["slo"]
     assert "p99" in slo["stages"]["dispatch"], slo
     assert slo["deadline"]["miss"] >= shed[0], slo
     assert "error_budget_burn" in slo
+    assert st["faults"]["breakers"]["m"]["state"] == "closed", st["faults"]
     # post-swap traffic is v2: full-batch check against the real model
     post = rpc(ctl, {"op": "predict", "id": "post-swap", "model": "m",
                      "data": X[:8].tolist()})
     ref = np.asarray(v2.inplace_predict(X[:8]), np.float64)
     assert np.allclose(post["result"], ref, atol=1e-6)
-    rpc(ctl, {"op": "shutdown"}); ctl.close()
-    proc.wait(timeout=120)
-    print(f"serving smoke OK: {served[0]} served + {shed[0]} shed of "
-          f"{total}, hot swap mid-traffic, metrics + stats exported")
+
+    # ---- crash-only SIGTERM drain, mid-traffic (ISSUE 10) ----
+    wave_ok, wave_shed, wave_done = [0], [0], threading.Event()
+    def wave():
+        c = socket.create_connection(("127.0.0.1", port), timeout=120)
+        try:
+            for i in range(50):
+                r = rpc(c, {"op": "predict", "id": f"w-{i}", "model": "m",
+                            "data": X[:2].tolist(), "timeout_s": 120.0})
+                if r is None:
+                    break  # EOF after the drain: request never admitted
+                if r.get("shed") == "draining":
+                    wave_shed[0] += 1
+                    break  # drain reached us: stop sending
+                assert "result" in r, f"admitted request lost: {r}"
+                wave_ok[0] += 1
+        finally:
+            c.close(); wave_done.set()
+    wt = threading.Thread(target=wave); wt.start()
+    while wave_ok[0] < 2 and not wave_done.is_set():
+        time.sleep(0.01)  # at least 2 requests admitted before the TERM
+    proc.send_signal(signal.SIGTERM)
+    wt.join(timeout=120)
+    rc = proc.wait(timeout=120)
+    assert rc == 0, f"SIGTERM drain exited {rc}, not 0"
+    assert wave_ok[0] >= 2, (wave_ok, wave_shed)
+    ctl.close()
+    print(f"serving chaos smoke OK: {served[0]} served + {shed[0]} shed "
+          f"+ 1 poison of {total}, quarantine + breaker live, hot swap "
+          f"mid-traffic, SIGTERM drained {wave_ok[0]} ok/{wave_shed[0]} "
+          "shed, rc 0")
 finally:
     if proc.poll() is None:
         proc.kill()
@@ -348,12 +424,19 @@ for ln in open(os.path.join(server_dir, "access.jsonl")):
         rec = json.loads(ln)
         if rec.get("t") == "req":
             access.append(rec)
-# one line per request: the 200 traffic requests + the post-swap check
-assert len(access) == total + 1, f"access log {len(access)} != {total + 1}"
+# one line per ANSWERED request: 200 traffic (incl. the poison error),
+# the quarantine re-send, the post-swap check, and every wave response
+# the drain answered before exiting (EOF'd sends were never admitted)
+expect = total + 2 + wave_ok[0] + wave_shed[0]
+assert len(access) == expect, f"access log {len(access)} != {expect}"
 ids = {r["id"] for r in access}
 assert "post-swap" in ids and "0-0" in ids and f"{N_CLIENTS-1}-{PER-1}" in ids
 n_shed = sum(1 for r in access if r["outcome"] == "shed")
-assert n_shed == shed[0], (n_shed, shed)
+assert n_shed == shed[0] + 1 + wave_shed[0], (n_shed, shed, wave_shed)
+n_err = sum(1 for r in access if r["outcome"] == "error")
+assert n_err == 1, f"exactly the poison request errors, got {n_err}"
+poison_line = next(r for r in access if r["outcome"] == "error")
+assert poison_line["id"] == "0-10" and "RequestError" in poison_line["error"]
 assert all(r["outcome"] != "ok" or "dispatch_s" in r for r in access)
 
 from xgboost_tpu.cli import cli_main
@@ -365,6 +448,7 @@ assert rc == 0, f"serve-report failed (rc={rc}):\n{out}"
 # >= 1 model's percentiles, the swap on the timeline, the exemplar table
 assert "m@v1" in out and "m@v2" in out and "p50" in out and "p99" in out, out
 assert "model_swap(m@v2)" in out, out
+assert "server_drain" in out, out  # the SIGTERM drain is on the timeline
 assert "shed[deadline]=" in out, out
 assert "worst-request exemplars" in out, out
 
@@ -380,7 +464,36 @@ linked = sorted(i for e in batch_links for i in e["args"]["requests"])
 ok_ids = sorted(r["id"] for r in access if r["outcome"] == "ok")
 assert linked == ok_ids, "batch spans must link exactly the served ids"
 print(f"serve-report OK: {len(access)} access lines, {len(tracks)} request "
-      f"tracks, {len(batch_links)} batch spans, swap + sheds on timeline")
+      f"tracks, {len(batch_links)} batch spans, swap + drain + sheds on "
+      "timeline")
+
+# ---- crash-only restart: both models re-served from the manifest ----
+man = json.load(open(os.path.join(run_dir, "manifest.json")))
+assert man["models"]["m"]["live"] == 2, man
+assert "m2" in man["models"], man
+proc2 = start_server([])  # NO --model: the manifest is the model set
+try:
+    c2 = socket.create_connection(("127.0.0.1", port), timeout=120)
+    r = rpc(c2, {"op": "predict", "id": "re-m", "model": "m",
+                 "data": X[:8].tolist()})
+    assert np.allclose(r["result"],
+                       np.asarray(v2.inplace_predict(X[:8]), np.float64),
+                       atol=1e-6), "restart lost the live v2 pointer"
+    r = rpc(c2, {"op": "predict", "id": "re-m2", "model": "m2",
+                 "data": X[:8].tolist()})
+    assert np.allclose(r["result"],
+                       np.asarray(v1.inplace_predict(X[:8]), np.float64),
+                       atol=1e-6), "restart lost m2"
+    exp = rpc(c2, {"op": "metrics"})["metrics"]
+    assert "serving_model_misses_total 2" in exp, \
+        "restart should fault BOTH models in lazily"
+    rpc(c2, {"op": "shutdown"}); c2.close()
+    assert proc2.wait(timeout=120) == 0
+    print("crash-only restart OK: m@v2 + m2@v1 re-faulted from manifest")
+finally:
+    if proc2.poll() is None:
+        proc2.kill()
+
 EOF
 
 echo "=== tier 2: trace parses as Chrome trace JSON ==="

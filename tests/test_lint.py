@@ -31,7 +31,9 @@ def test_package_lints_clean_against_baseline():
         f.render() for f in new)
     assert not stale, f"stale baseline entries: {stale}"
     # the baseline is a ratchet, not a landfill: it must stay small
-    assert len(suppressed) < 25
+    # (raised 25 -> 35 with RS502: the observability/protocol swallows
+    # under serving/ are individually justified survivors)
+    assert len(suppressed) < 35
 
 
 def test_baseline_entries_all_justified():

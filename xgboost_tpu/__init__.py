@@ -70,6 +70,7 @@ __all__ = [
     "set_config",
     "get_config",
     "ModelServer",
+    "RequestError",
     "RequestShed",
     "__version__",
 ]
@@ -92,7 +93,7 @@ def __getattr__(name):
     # so `import xgboost_tpu` doesn't pay for the server machinery.
     # import_module, not `from . import`: the latter re-enters this
     # __getattr__ while the submodule attribute is still unset
-    if name in ("ModelServer", "RequestShed", "serving"):
+    if name in ("ModelServer", "RequestError", "RequestShed", "serving"):
         import importlib
 
         _serving = importlib.import_module(".serving", __name__)

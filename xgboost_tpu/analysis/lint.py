@@ -73,6 +73,7 @@ ALL_RULES = {
     "CC402": "global rebound outside a lock",
     "CC403": "module-level fallback latch outside resilience/degrade.py",
     "RS501": "direct collective call site outside collective.py",
+    "RS502": "bare broad except swallow on the serving dispatch path",
 }
 
 # RS501: every collective must route through the guarded entry point
@@ -87,6 +88,20 @@ _RS501_NAMES = {"psum", "psum_scatter", "all_gather", "all_to_all",
                 "sync_global_devices"}
 _RS501_ROOTS = {"jax", "lax", "multihost_utils"}
 _RS501_EXEMPT = "collective.py"
+
+# RS502: a bare ``except Exception`` swallow on the serving dispatch
+# path hides a failure from the resilience layer — it neither retries,
+# bisects, trips the model's breaker, nor lands in
+# faults_total/serving_faults_total, so a co-batched caller's error
+# silently becomes a wrong or missing response. Failures under
+# ``serving/`` must either re-raise or route through classification
+# (``resilience.policy.classify``/``record_failure`` or
+# ``serving.faults.record_serving_fault``); only ``serving/faults.py``
+# (the isolation ladder itself) may catch broadly without that.
+_RS502_SCOPE_DIR = "serving"
+_RS502_EXEMPT = "serving/faults.py"
+_RS502_BROAD = {"Exception", "BaseException"}
+_RS502_CLASSIFIERS = {"classify", "record_failure", "record_serving_fault"}
 
 # CC403: module-level names that read as fallback latches (broken/failed/
 # blocked/... flags and blacklist dicts). Capability state belongs in the
@@ -1008,6 +1023,55 @@ def _pass_collectives(project: _Project) -> List[Finding]:
     return out
 
 
+def _pass_serving_excepts(project: _Project) -> List[Finding]:
+    """RS502: ``except Exception``/``except BaseException`` handlers under
+    ``serving/`` (outside ``serving/faults.py``) that neither re-raise nor
+    route the failure through the resilience classification entry points.
+    A handler is clean if its body contains any ``raise`` or a call whose
+    attribute chain ends in ``classify``/``record_failure``/
+    ``record_serving_fault``."""
+    out: List[Finding] = []
+    for mod in project.modules:
+        if not mod.in_scope((_RS502_SCOPE_DIR,)):
+            continue
+        if mod.relpath.endswith(_RS502_EXEMPT):
+            continue
+        symbols = _symbol_index(mod)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            caught = node.type
+            names: List[str] = []
+            for t in (caught.elts if isinstance(caught, ast.Tuple)
+                      else [caught]) if caught is not None else []:
+                chain = _attr_chain(t)
+                if chain:
+                    names.append(chain[-1])
+            if not any(n in _RS502_BROAD for n in names):
+                continue
+            handled = False
+            for sub in ast.walk(ast.Module(body=node.body,
+                                           type_ignores=[])):
+                if isinstance(sub, ast.Raise):
+                    handled = True
+                    break
+                if isinstance(sub, ast.Call):
+                    chain = _attr_chain(sub.func)
+                    if chain and chain[-1] in _RS502_CLASSIFIERS:
+                        handled = True
+                        break
+            if handled:
+                continue
+            out.append(Finding(
+                "RS502", mod.relpath, node.lineno,
+                symbols.get(node.lineno, "<module>"),
+                "broad except swallow on the serving dispatch path: "
+                "re-raise, or classify via resilience.policy / "
+                "serving.faults.record_serving_fault so retries, "
+                "bisection and breakers see the failure"))
+    return out
+
+
 # ---------------------------------------------------------------------------
 # entry points
 # ---------------------------------------------------------------------------
@@ -1033,6 +1097,7 @@ def lint_paths(paths: Optional[Sequence[str]] = None,
     findings += _pass_dtype(project)
     findings += _pass_concurrency(project)
     findings += _pass_collectives(project)
+    findings += _pass_serving_excepts(project)
     if rules:
         findings = [f for f in findings if f.rule in rules]
     # dedupe (two detection routes can hit the same node)

@@ -847,6 +847,12 @@ class Booster:
     def _num_feature(self) -> int:
         for d in self._cache_refs.values():
             return d.num_col()
+        # a loaded model's learner_model_param carries the exact training
+        # width (reference LearnerModelParam::num_feature) — prefer it
+        # over the max-split-index lower bound, so serving-side width
+        # validation can be exact after a save/load round trip
+        if getattr(self, "_loaded_num_feature", 0):
+            return int(self._loaded_num_feature)
         if getattr(self._gbm, "model", None) and self._gbm.model.trees:
             return int(max(t.split_indices.max(initial=0) for t in self._gbm.model.trees) + 1)
         return 0
@@ -879,6 +885,10 @@ class Booster:
             self._configure()
         self._gbm.load_json(gb)
         self.attributes_ = dict(learner.get("attributes", {}))
+        try:
+            self._loaded_num_feature = int(lmp.get("num_feature", 0))
+        except (TypeError, ValueError):
+            self._loaded_num_feature = 0
         self._loaded_feature_names = list(learner.get("feature_names", []))
         self._loaded_feature_types = list(learner.get("feature_types", []))
         self._caches.clear()

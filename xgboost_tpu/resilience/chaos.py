@@ -30,6 +30,19 @@ site                injection point
 ``collective_timeout``  every guarded host-side collective
                     (``collective.guarded``): a fired hit presents as a
                     transient deadline expiry at that exact site
+``serving_dispatch``  every coalesced micro-batch dispatch attempt
+                    (``serving/batcher.py``): a fired hit fails the
+                    attempt and drives the isolation ladder — same-batch
+                    retry for transients, bisection for the rest
+                    (``serving/faults.py``)
+``serving_model_load``  every booster (re)build from a retained model
+                    source (``serving/tenancy.py`` ``load_booster``):
+                    initial loads, hot-swap loads and LRU fault-back-ins
+``serving_swap``    every hot-swap attempt (``serving/swap.py``)
+``batcher_wedge``   the batcher worker right before a dispatch: a fired
+                    hit WEDGES the worker thread (it parks instead of
+                    raising) so the batcher watchdog's detect -> fail
+                    futures -> respawn path is exercisable in tests
 ==================  =====================================================
 
 Configuration — ``XGBTPU_CHAOS="site:kind:schedule[;site:kind:schedule]"``
@@ -71,7 +84,9 @@ _ENV = "XGBTPU_CHAOS"
 #: e.g. synthetic sites in tests)
 SITES = ("compile", "pallas", "collective", "pager_io", "native_load",
          "checkpoint_write", "gradient", "grow", "eval",
-         "worker_kill", "heartbeat_drop", "collective_timeout")
+         "worker_kill", "heartbeat_drop", "collective_timeout",
+         "serving_dispatch", "serving_model_load", "serving_swap",
+         "batcher_wedge")
 
 
 class ChaosError(RuntimeError):

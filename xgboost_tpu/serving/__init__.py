@@ -19,7 +19,13 @@ the serving-side analog of the reference's bindings/frontends tier
 - :mod:`~xgboost_tpu.serving.obs` — request-scope observability (ISSUE
   9): per-request ids/traces/access log, the per-dispatch flight ring,
   and the SLO ledger (stage histograms, error-budget burn, exemplars)
-  feeding ``python -m xgboost_tpu serve-report``.
+  feeding ``python -m xgboost_tpu serve-report``;
+- :mod:`~xgboost_tpu.serving.faults` — the self-healing layer (ISSUE
+  10): batch fault isolation with bisection re-dispatch (typed
+  ``RequestError`` for exactly the poison members), per-model circuit
+  breakers, input quarantine, the batcher-worker watchdog, and the
+  crash-only manifest/SIGTERM-drain contract (docs/serving.md
+  "Failure handling").
 
 Entry points: :class:`ModelServer` (``xgb.ModelServer``) in Python,
 ``python -m xgboost_tpu serve`` for the JSONL stdin/socket protocol.
@@ -29,13 +35,17 @@ request").
 
 from .admission import AdmissionController, RequestShed  # noqa: F401
 from .batcher import MicroBatcher  # noqa: F401
+from .faults import (  # noqa: F401
+    CircuitBreaker, FaultDomain, Quarantine, RequestError,
+)
 from .obs import ServingRecorder, SLOLedger  # noqa: F401
 from .server import ModelServer, serve_main  # noqa: F401
 from .swap import hot_swap  # noqa: F401
 from .tenancy import ModelEntry, ModelRegistry  # noqa: F401
 
 __all__ = [
-    "AdmissionController", "MicroBatcher", "ModelEntry", "ModelRegistry",
-    "ModelServer", "RequestShed", "SLOLedger", "ServingRecorder",
+    "AdmissionController", "CircuitBreaker", "FaultDomain", "MicroBatcher",
+    "ModelEntry", "ModelRegistry", "ModelServer", "Quarantine",
+    "RequestError", "RequestShed", "SLOLedger", "ServingRecorder",
     "hot_swap", "serve_main",
 ]

@@ -22,6 +22,15 @@ queues). This module is the decision layer in front of the micro-batcher:
   server keeps answering at reduced throughput instead of queueing behind
   a faulting device path. State transitions stay owned by the capability
   machine (docs/resilience.md); this layer only *reads* it.
+- **fault-plane sheds** (ISSUE 10, ``serving/faults.py``) — a request for
+  a model whose **circuit breaker** is OPEN sheds with reason
+  ``breaker`` (the half-open probe is the one admitted exception); a
+  payload whose fingerprint is **quarantined** (a repeat poison
+  offender) sheds with reason ``quarantine``; a structurally
+  **invalid** payload (wrong width, oversized, non-finite inf values)
+  is rejected with reason ``invalid`` before it can throw inside a
+  coalesced dispatch; a **draining** server (SIGTERM received) sheds
+  new arrivals with reason ``draining`` while queued requests finish.
 
 Every decision is observable: ``requests_shed_total{reason=...}``,
 ``serving_admitted_total``, ``serving_degraded_routes_total``.
@@ -35,6 +44,7 @@ from typing import Optional
 
 from ..observability.metrics import REGISTRY
 from ..resilience import degrade
+from .faults import FaultDomain
 
 __all__ = ["RequestShed", "AdmissionController"]
 
@@ -42,6 +52,10 @@ __all__ = ["RequestShed", "AdmissionController"]
 QUEUE_FULL = "queue_full"
 DEADLINE = "deadline"  # already past due at decision time
 SLO = "slo"  # projected completion overshoots the deadline
+BREAKER = "breaker"  # the model's circuit breaker is OPEN
+QUARANTINE = "quarantine"  # repeat poison offender fingerprint
+INVALID = "invalid"  # malformed payload rejected at admission
+DRAINING = "draining"  # SIGTERM drain in progress
 
 #: p99 prior (seconds) used before the latency histogram has samples: a
 #: generous whole-bucket-walk estimate so a cold server does not shed its
@@ -71,15 +85,23 @@ class AdmissionController:
     depth from the batcher, p99 from the metrics registry, health from the
     degrade machine). One instance per :class:`~xgboost_tpu.serving.ModelServer`."""
 
-    def __init__(self, max_queue: Optional[int] = None):
+    def __init__(self, max_queue: Optional[int] = None,
+                 faults: Optional[FaultDomain] = None):
         self.max_queue = max(1, max_queue if max_queue is not None
                              else _env_int("XGBTPU_SERVING_QUEUE", 1024))
+        #: the server's fault domain (breakers + quarantine); a bare
+        #: controller owns a private one so direct MicroBatcher users
+        #: still get isolation/quarantine/breaker behavior
+        self.faults = faults if faults is not None else FaultDomain()
+        #: SIGTERM drain flag (set via the owning server's begin_drain)
+        self.draining = False
         # pre-create the families so a healthy server's exposition still
         # documents the shed/admit surface (scrapers see zeros, not gaps)
         self._shed = REGISTRY.counter(
             "requests_shed_total",
             "Requests declined by SLO-aware admission, by reason")
-        for reason in (QUEUE_FULL, DEADLINE, SLO):
+        for reason in (QUEUE_FULL, DEADLINE, SLO, BREAKER, QUARANTINE,
+                       INVALID, DRAINING):
             self._shed.labels(reason=reason)
         self._admitted = REGISTRY.counter(
             "serving_admitted_total", "Requests admitted into the batcher")
@@ -107,13 +129,32 @@ class AdmissionController:
         q = REGISTRY.quantile("predict_latency_seconds", 0.99)
         return _COLD_P99_S if q is None else max(q, 1e-6)
 
+    def invalid(self, detail: str) -> RequestShed:
+        """Count and build the typed rejection for a structurally
+        malformed payload (the batcher raises it BEFORE the request can
+        reach the queue — satellite: malformed dense payloads must not
+        throw inside a coalesced dispatch)."""
+        self._shed.labels(reason=INVALID).inc()
+        return RequestShed(INVALID, detail)
+
     def admit(self, queue_depth: int,
               deadline: Optional[float] = None,
-              model: str = "") -> None:
+              model: str = "",
+              fingerprint: Optional[int] = None) -> None:
         """Raise :class:`RequestShed` if the request should not enter the
         queue; record the admission otherwise. ``deadline`` is an absolute
         ``time.monotonic()`` instant (None = no SLO); ``model`` scopes
-        the p99 estimate to the tenant being requested."""
+        the p99 estimate to the tenant being requested; ``fingerprint``
+        is the payload's quarantine key (None = not fingerprintable)."""
+        if self.draining:
+            self._shed.labels(reason=DRAINING).inc()
+            raise RequestShed(DRAINING, "server is draining (SIGTERM)")
+        if self.faults.quarantine.quarantined(fingerprint):
+            self._shed.labels(reason=QUARANTINE).inc()
+            raise RequestShed(
+                QUARANTINE,
+                f"input fingerprint {fingerprint:08x} is a repeat "
+                "poison offender")
         if queue_depth >= self.max_queue:
             self._shed.labels(reason=QUEUE_FULL).inc()
             raise RequestShed(
@@ -134,6 +175,15 @@ class AdmissionController:
                          f"(queue depth {queue_depth}, "
                          f"p99 {p99 * 1e3:.2f}ms"
                          + (f" for {model}" if model else "") + ")")
+        # breaker LAST: an admitted half-open probe must actually reach
+        # dispatch, so it only burns its slot after every cheaper check
+        # has passed (a probe shed on queue_full would wedge recovery)
+        if model:
+            name = model.split("@", 1)[0]
+            if not self.faults.breaker(name).allow():
+                self._shed.labels(reason=BREAKER).inc()
+                raise RequestShed(
+                    BREAKER, f"circuit breaker for {name!r} is open")
         self._admitted.inc()
 
     def shed_at_dispatch(self, reason: str = DEADLINE) -> RequestShed:

@@ -17,13 +17,29 @@ Knobs (env, read at construction):
   0 = dispatch immediately, coalescing only what is already queued.
 - ``XGBTPU_BATCH_MAX_ROWS`` (default 4096) — rows per drain cycle; a full
   cycle dispatches without waiting out the window.
+- ``XGBTPU_MAX_REQUEST_ROWS`` (default 65536) — per-request row cap;
+  larger payloads are rejected at admission (reason ``invalid``).
+- ``XGBTPU_BATCHER_WATCHDOG`` (default 60, seconds; 0 disables) — how
+  long one dispatch may block the worker before the watchdog declares it
+  wedged, fails its in-flight futures with a typed
+  :class:`~xgboost_tpu.serving.faults.RequestError` and respawns the
+  worker (crash-only: the queue and every waiting caller survive).
 
 Correctness invariants: rows are walked per-row-independently on every
 route (XLA program, pallas, native walker), so a coalesced result is
 bit-identical to the same request served alone; requests that cannot
 coalesce (sparse inputs, explicit base margins) still ride the same queue
 but dispatch as their own group. Dispatch-time deadline re-checks shed
-requests that aged out while queued (``admission.py``).
+requests that aged out while queued (``admission.py``), and futures a
+caller cancelled are skipped at dispatch-assembly time and counted as
+``serving_requests_total{outcome="abandoned"}`` — an abandoned client
+neither keeps its queue slot nor blocks batch completion.
+
+Failure handling (ISSUE 10, ``serving/faults.py``): a failed coalesced
+dispatch is classified through ``resilience.policy`` — transients get one
+bounded same-batch retry, anything persistent is bisected until the
+poison member(s) alone fail with a typed ``RequestError`` while innocent
+co-batched requests succeed (docs/serving.md "Failure handling").
 """
 
 from __future__ import annotations
@@ -32,12 +48,14 @@ import os
 import queue
 import threading
 import time
-from concurrent.futures import Future
+from concurrent.futures import Future, InvalidStateError
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from ..observability.metrics import REGISTRY
+from ..resilience import chaos, policy
+from . import faults
 from .admission import AdmissionController, RequestShed
 from .obs import RequestRecord, ServingRecorder
 from .tenancy import ModelEntry
@@ -54,15 +72,23 @@ def _env_int(name: str, default: int) -> int:
         return default
 
 
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, str(default)))
+    except ValueError:
+        return default
+
+
 class _Request:
     __slots__ = ("entry", "X", "n", "group_key", "predict_type",
                  "iteration_range", "missing", "base_margin", "deadline",
-                 "future", "rec")
+                 "future", "rec", "fp")
 
     def __init__(self, entry: ModelEntry, X, n: int, group_key: Tuple,
                  predict_type: str, iteration_range, missing, base_margin,
                  deadline: Optional[float],
-                 rec: Optional[RequestRecord]) -> None:
+                 rec: Optional[RequestRecord],
+                 fp: Optional[int] = None) -> None:
         self.entry = entry
         self.X = X
         self.n = n
@@ -73,6 +99,7 @@ class _Request:
         self.base_margin = base_margin
         self.deadline = deadline
         self.rec = rec
+        self.fp = fp
         self.future: "Future" = Future()
         if rec is not None:
             # the response side of request tracing: every future carries
@@ -82,8 +109,10 @@ class _Request:
 
 class MicroBatcher:
     """The queue + worker thread. One per :class:`~xgboost_tpu.serving.ModelServer`;
-    admission decisions (queue bound, deadline shed, degrade routing) are
-    delegated to the attached :class:`AdmissionController`."""
+    admission decisions (queue bound, deadline shed, degrade routing,
+    breaker/quarantine sheds) are delegated to the attached
+    :class:`AdmissionController`, whose fault domain also drives the
+    isolation machinery here."""
 
     def __init__(self, admission: Optional[AdmissionController] = None,
                  *, obs: Optional[ServingRecorder] = None,
@@ -97,6 +126,10 @@ class MicroBatcher:
             max_batch_rows = _env_int("XGBTPU_BATCH_MAX_ROWS", 4096)
         self.max_wait_s = max(0, max_wait_us) / 1e6
         self.max_batch_rows = max(1, max_batch_rows)
+        self.max_request_rows = max(
+            1, _env_int("XGBTPU_MAX_REQUEST_ROWS", 65536))
+        self.watchdog_s = max(0.0, _env_float("XGBTPU_BATCHER_WATCHDOG",
+                                              60.0))
         self._q: "queue.Queue" = queue.Queue()
         self._depth = REGISTRY.gauge(
             "serving_queue_depth", "Requests waiting in the batcher queue")
@@ -108,14 +141,30 @@ class MicroBatcher:
             "Requests served through the micro-batcher")
         self._rows = REGISTRY.counter(
             "serving_rows_total", "Rows served through the micro-batcher")
+        self._respawns = REGISTRY.counter(
+            "serving_worker_respawns_total",
+            "Batcher worker threads respawned by the wedge watchdog")
         self._depth.set(0)
         self._dispatches.inc(0)
         self._batched.inc(0)
+        self._respawns.inc(0)
         self._closed = False
         self._lock = threading.Lock()
+        # worker generation: the watchdog bumps it when it declares the
+        # current worker wedged; a stale worker sees the bump and exits
+        # without touching queue or futures (crash-only respawn)
+        self._gen = 0
+        self._inflight: List[_Request] = []
+        self._busy_since = 0.0
         self._worker = threading.Thread(
-            target=self._loop, name="xgbtpu-serving-batcher", daemon=True)
+            target=self._loop, args=(0,),
+            name="xgbtpu-serving-batcher", daemon=True)
         self._worker.start()
+        if self.watchdog_s > 0:
+            self._watchdog = threading.Thread(
+                target=self._watchdog_loop,
+                name="xgbtpu-batcher-watchdog", daemon=True)
+            self._watchdog.start()
 
     # ------------------------------------------------------------------
     def submit(self, entry: ModelEntry, data, *,
@@ -126,9 +175,10 @@ class MicroBatcher:
         """Enqueue one predict request against a pinned model entry.
         Returns a Future resolving to the prediction array (rows in input
         order), or raising :class:`~xgboost_tpu.serving.RequestShed` /
-        the dispatch error. ``deadline`` is absolute ``time.monotonic()``;
-        ``rec`` is the server's request-trace record — sealed here on a
-        shed/refusal, by the dispatch path otherwise."""
+        a typed dispatch error. ``deadline`` is absolute
+        ``time.monotonic()``; ``rec`` is the server's request-trace
+        record — sealed here on a shed/refusal, by the dispatch path
+        otherwise."""
         try:
             return self._submit(entry, data, predict_type=predict_type,
                                 iteration_range=iteration_range,
@@ -164,7 +214,29 @@ class MicroBatcher:
                     f"sparse matrices, got {type(data).__name__}")
             missing = np.nan  # sentinel already folded into NaN
             coalescible = base_margin is None
-        n = X.shape[0]
+        # structural validation BEFORE the queue (satellite: a malformed
+        # dense payload must be rejected with a typed error at admission,
+        # not throw inside the coalesced dispatch and poison co-batched
+        # callers) — reason "invalid" on requests_shed_total
+        n = int(X.shape[0])
+        nf = entry.booster.num_features()
+        if nf and int(X.shape[1]) != int(nf):
+            raise self.admission.invalid(
+                f"payload width {X.shape[1]} != model features {nf} "
+                f"for {entry.label}")
+        if n == 0:
+            raise self.admission.invalid("empty payload (0 rows)")
+        if n > self.max_request_rows:
+            raise self.admission.invalid(
+                f"payload rows {n} > XGBTPU_MAX_REQUEST_ROWS="
+                f"{self.max_request_rows}")
+        vals = X.data if not coalescible and hasattr(X, "data") \
+            and not isinstance(X, np.ndarray) else X
+        if np.isinf(np.asarray(vals)).any():
+            raise self.admission.invalid(
+                "non-finite (inf) values in payload (use NaN for "
+                "missing)")
+        fp = faults.fingerprint(X) if coalescible else None
         if rec is not None:
             rec.rows = int(n)
         rkey = None if iteration_range is None else tuple(iteration_range)
@@ -174,7 +246,7 @@ class MicroBatcher:
             # qsize is exact under the lock only for submitters; the
             # worker draining concurrently just makes admission lenient
             self.admission.admit(self._q.qsize(), deadline,
-                                 model=entry.label)
+                                 model=entry.label, fingerprint=fp)
             req = _Request(
                 entry, X, n,
                 # sparse / base-margin requests get an identity key: they
@@ -182,15 +254,18 @@ class MicroBatcher:
                 (id(entry), predict_type, rkey, X.shape[1])
                 if coalescible else (object(),),
                 predict_type, iteration_range, missing, base_margin,
-                deadline, rec)
+                deadline, rec, fp)
             entry.acquire()
             self._q.put(req)
             self._depth.set(self._q.qsize())
         return req.future
 
     # ------------------------------------------------------------------
-    def _loop(self) -> None:
+    def _loop(self, gen: int) -> None:
         while True:
+            with self._lock:
+                if self._gen != gen or self._closed and self._q.empty():
+                    return
             item = self._q.get()
             if item is _STOP:
                 break
@@ -214,69 +289,158 @@ class MicroBatcher:
                 batch.append(nxt)
                 rows += nxt.n
             self._depth.set(self._q.qsize())
-            self._run_batch(batch)
+            with self._lock:
+                if self._gen != gen:
+                    # replaced while assembling: hand the batch to the
+                    # error path (we must not race the live worker)
+                    stale_batch = batch
+                else:
+                    stale_batch = None
+                    self._inflight = batch
+                    self._busy_since = time.monotonic()
+            if stale_batch is not None:
+                for req in stale_batch:
+                    self._resolve_err(req, faults.RequestError(
+                        "batcher_wedge", policy.TRANSIENT,
+                        "batcher worker replaced mid-assembly"))
+                return
+            try:
+                self._run_batch(batch, gen)
+            finally:
+                with self._lock:
+                    if self._gen == gen:
+                        self._inflight = []
+                        self._busy_since = 0.0
 
-    def _run_batch(self, batch: List[_Request]) -> None:
+    def _watchdog_loop(self) -> None:
+        """Detect a wedged worker: a dispatch that has blocked the worker
+        thread past ``XGBTPU_BATCHER_WATCHDOG`` seconds gets its in-flight
+        futures failed (typed, site ``batcher_wedge``) and a fresh worker
+        spawned — queued requests behind the wedge keep being served.
+        The wedged thread itself is abandoned (its generation is stale;
+        anything it eventually returns is discarded)."""
+        interval = max(0.02, min(1.0, self.watchdog_s / 4))
+        while True:
+            time.sleep(interval)
+            with self._lock:
+                if self._closed:
+                    return
+                busy = self._busy_since
+                if not busy or (time.monotonic() - busy) < self.watchdog_s:
+                    continue
+                batch = self._inflight
+                self._inflight = []
+                self._busy_since = 0.0
+                self._gen += 1
+                gen = self._gen
+                self._worker = threading.Thread(
+                    target=self._loop, args=(gen,),
+                    name=f"xgbtpu-serving-batcher-{gen}", daemon=True)
+                self._worker.start()
+            faults.record_serving_fault(
+                "batcher_wedge", kind=policy.TRANSIENT)
+            self._respawns.inc()
+            if self.obs is not None:
+                self.obs.event("batcher_respawn", inflight=len(batch),
+                               deadline_s=self.watchdog_s)
+            for req in batch:
+                rid = req.rec.id if req.rec is not None else None
+                self._resolve_err(req, faults.RequestError(
+                    "batcher_wedge", policy.TRANSIENT,
+                    f"batcher worker wedged > {self.watchdog_s}s; "
+                    "in-flight futures failed, worker respawned",
+                    request_id=rid))
+
+    def _run_batch(self, batch: List[_Request], gen: int) -> None:
+        try:
+            chaos.hit("batcher_wedge")
+        except chaos.ChaosError:
+            # scripted wedge: park (GIL-friendly) until the watchdog
+            # replaces this worker or the batcher closes — the testable
+            # analog of a dispatch stuck in native code
+            while True:
+                with self._lock:
+                    if self._gen != gen or self._closed:
+                        return
+                time.sleep(0.02)
         groups: "Dict[Tuple, List[_Request]]" = {}
         now = time.monotonic()
         for req in batch:
+            if not self._claim(req):
+                self._abandon(req)
+                continue
             if req.deadline is not None and now >= req.deadline:
                 self._resolve_err(req, self.admission.shed_at_dispatch())
                 continue
             groups.setdefault(req.group_key, []).append(req)
         force_native = self.admission.route_native() if groups else False
         for grp in groups.values():
-            self._dispatch_group(grp, force_native)
+            self._dispatch_group(grp, force_native, gen)
 
     def _dispatch_group(self, grp: List[_Request],
-                        force_native: bool) -> None:
+                        force_native: bool, gen: int) -> None:
         from ..predictor.serving import bucket_rows, last_route
 
         first = grp[0]
+        domain = self.admission.faults
         rows = sum(r.n for r in grp)
         h0, m0 = self._cache_counts()
         t0 = time.perf_counter_ns()
-        try:
-            if len(grp) == 1:
-                X = first.X
-            else:
-                X = np.concatenate([r.X for r in grp], axis=0)
-            out = first.entry.predict(
+
+        def dispatch(sub: List[_Request]):
+            chaos.hit("serving_dispatch")
+            X = sub[0].X if len(sub) == 1 else \
+                np.concatenate([r.X for r in sub], axis=0)
+            faults.check_poison(X)
+            return first.entry.predict(
                 X, predict_type=first.predict_type,
                 iteration_range=first.iteration_range,
                 missing=first.missing, base_margin=first.base_margin,
                 force_native=force_native)
-            self._dispatches.inc()
-            self._batched.inc(len(grp))
-            self._rows.inc(rows)
-        except BaseException as e:  # noqa: BLE001 — worker must survive
-            for req in grp:
-                self._resolve_err(req, e)
-            return
+
+        # the isolation ladder (faults.py): clean traffic costs exactly
+        # one dispatch() call; classification/retry/bisection only run
+        # once a failure has already happened (the ≤2% overhead pin)
+        ok, failed = faults.isolate_dispatch(
+            grp, dispatch, domain=domain, model=first.entry.name)
         t1 = time.perf_counter_ns()
+        domain.breaker(first.entry.name).record(
+            ok=not failed, latency_s=(t1 - t0) / 1e9)
+        with self._lock:
+            if self._gen != gen:
+                return  # watchdog already failed this batch's futures
+        if ok:
+            self._dispatches.inc()
+            self._batched.inc(len(ok))
+            self._rows.inc(sum(r.n for r, _ in ok))
         route = last_route()  # this thread ran the dispatch: exact
         bucket = bucket_rows(rows)
         h1, m1 = self._cache_counts()
-        recs = [r.rec for r in grp if r.rec is not None]
-        for req in grp:
+        ok_reqs = [r for r, _ in ok]
+        recs = [r.rec for r in ok_reqs if r.rec is not None]
+        for req in ok_reqs:
             if req.rec is not None:
                 req.rec.t_dispatch0 = t0
                 req.rec.t_dispatch1 = t1
                 req.rec.route = route
                 req.rec.bucket = bucket
                 req.rec.coalesced = len(grp)
-        if self.obs is not None:
+        if self.obs is not None and ok:
             self.obs.dispatch(
-                recs, model=first.entry.label, rows=rows, bucket=bucket,
+                recs, model=first.entry.label,
+                rows=sum(r.n for r, _ in ok), bucket=bucket,
                 route=route, cache_hits=h1 - h0, cache_misses=m1 - m0,
                 queue_depth=self._q.qsize(), t0_ns=t0, t1_ns=t1)
             for rec in recs:
                 self.obs.finish(rec, "ok")
-        off = 0
-        for req in grp:
+        for req, out in ok:
             req.entry.release()
-            req.future.set_result(np.asarray(out[off: off + req.n]))
-            off += req.n
+            self._set_result(req.future, out)
+        for req, exc in failed:
+            rid = req.rec.id if req.rec is not None else None
+            self._resolve_err(req, faults.RequestError(
+                faults.DISPATCH_SITE, policy.classify(exc),
+                f"{type(exc).__name__}: {exc}", request_id=rid))
 
     @staticmethod
     def _cache_counts() -> Tuple[float, float]:
@@ -290,6 +454,36 @@ class MicroBatcher:
             out.append(0.0 if fam is None else fam.labels().value)
         return out[0], out[1]
 
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _claim(req: _Request) -> bool:
+        """Move the future to RUNNING; False = the caller cancelled it
+        (the request is abandoned and must be skipped, not dispatched)."""
+        try:
+            return req.future.set_running_or_notify_cancel()
+        except InvalidStateError:
+            return True  # already claimed (close() racing the worker)
+
+    def _abandon(self, req: _Request) -> None:
+        """A cancelled future skipped at dispatch-assembly time: release
+        its model pin and count it — the caller went away, so nothing
+        else will."""
+        req.entry.release()
+        if self.obs is not None and req.rec is not None:
+            self.obs.finish(req.rec, "abandoned")
+        else:
+            REGISTRY.counter(
+                "serving_requests_total",
+                "Requests completed, by outcome",
+            ).labels(outcome="abandoned").inc()
+
+    @staticmethod
+    def _set_result(fut: "Future", value) -> None:
+        try:
+            fut.set_result(value)
+        except InvalidStateError:
+            pass  # cancelled/failed concurrently: result has no taker
+
     def _resolve_err(self, req: _Request, exc: BaseException) -> None:
         req.entry.release()
         if self.obs is not None and req.rec is not None:
@@ -298,22 +492,31 @@ class MicroBatcher:
             else:
                 self.obs.finish(req.rec, "error",
                                 error=f"{type(exc).__name__}: {exc}")
-        req.future.set_exception(exc)
+        try:
+            req.future.set_exception(exc)
+        except InvalidStateError:
+            pass  # cancelled/resolved concurrently (watchdog vs worker)
 
     # ------------------------------------------------------------------
     def queue_depth(self) -> int:
         return self._q.qsize()
 
-    def close(self, drain: bool = True) -> None:
+    def close(self, drain: bool = True,
+              deadline_s: Optional[float] = None) -> None:
         """Stop the worker. ``drain=True`` serves everything already
-        queued first; either way, requests that slip in after the stop
-        marker fail with a closed-server error instead of hanging."""
+        queued first (bounded by ``deadline_s``, default 60 /
+        ``XGBTPU_DRAIN_DEADLINE_S``); either way, requests that slip in
+        after the stop marker fail with a closed-server error instead of
+        hanging."""
+        if deadline_s is None:
+            deadline_s = _env_float("XGBTPU_DRAIN_DEADLINE_S", 60.0)
         with self._lock:
             if self._closed:
                 return
             self._closed = True
+            worker = self._worker
             self._q.put(_STOP)
-        self._worker.join(timeout=60)
+        worker.join(timeout=max(0.1, deadline_s))
         leftovers = []
         while True:
             try:
@@ -323,9 +526,11 @@ class MicroBatcher:
             if item is not _STOP:
                 leftovers.append(item)
         for req in leftovers:
-            if drain:
+            if not self._claim(req):
+                self._abandon(req)
+            elif drain:
                 # close() raced the worker's exit: serve rather than drop
-                self._dispatch_group([req], False)
+                self._dispatch_group([req], False, self._gen)
             else:
                 self._resolve_err(
                     req, RuntimeError("model server closed before dispatch"))

@@ -210,7 +210,7 @@ class SLOLedger:
         self._requests = REGISTRY.counter(
             "serving_requests_total", "Requests completed, by outcome")
         self._by_outcome = {o: self._requests.labels(outcome=o)
-                            for o in ("ok", "shed", "error")}
+                            for o in ("ok", "shed", "error", "abandoned")}
         self._burn.set(0.0)
 
     def _model_child(self, stage: str, model: str):

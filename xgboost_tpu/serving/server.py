@@ -22,22 +22,28 @@ from __future__ import annotations
 
 import json
 import os
+import signal
 import socketserver
 import sys
 import threading
+import time
 from concurrent.futures import Future
 from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from ..observability import flight as _flight
 from ..observability.metrics import REGISTRY
 from .admission import AdmissionController, RequestShed
 from .batcher import MicroBatcher
+from .faults import FaultDomain
 from .obs import ServingRecorder
 from .swap import SwapRunner, warm_entry
 from .tenancy import ModelRegistry
 
 __all__ = ["ModelServer", "serve_main"]
+
+MANIFEST_FORMAT = "xgbtpu-manifest-v1"
 
 
 class ModelServer:
@@ -61,18 +67,36 @@ class ModelServer:
                  max_batch_rows: Optional[int] = None,
                  run_dir: Optional[str] = None) -> None:
         self.obs = ServingRecorder(run_dir)
-        self.registry = ModelRegistry(arena_mb, on_event=self.obs.event)
-        self.admission = AdmissionController(max_queue)
+        # the crash-only contract root: the resident-model manifest (and
+        # raw-source spill files) live directly under the run_dir, next
+        # to (not inside) the obs/ tree
+        self._run_root = run_dir or os.environ.get("XGBTPU_SERVE_DIR")
+        self.faults = FaultDomain(on_event=self.obs.event)
+        self.registry = ModelRegistry(arena_mb, on_event=self._on_event)
+        self.admission = AdmissionController(max_queue, faults=self.faults)
         self.batcher = MicroBatcher(
             self.admission, obs=self.obs, max_wait_us=batch_wait_us,
             max_batch_rows=max_batch_rows)
-        self._swapper = SwapRunner(self.registry, on_event=self.obs.event)
+        self._swapper = SwapRunner(self.registry, on_event=self._on_event)
         self._closed = False
+        self._draining = False
+        self._manifest_lock = threading.Lock()
+        if self._run_root:
+            self._restore_manifest()
         if models:
             for name, source in models.items():
                 self.load(name, source)
 
     # ------------------------------------------------------------------
+    def _on_event(self, name: str, **args: Any) -> None:
+        """Registry/swap event hook: timeline recording plus the
+        crash-only manifest — every change to the retained source set
+        (load, swap) atomically rewrites ``run_dir/manifest.json`` so a
+        killed-and-restarted server re-faults its full model set."""
+        self.obs.event(name, **args)
+        if name in ("model_load", "model_swap"):
+            self._write_manifest()
+
     def load(self, name: str, source: Any, *,
              version: Optional[int] = None, warm: bool = True) -> str:
         """Load a model version and make it live. Returns ``name@vN``."""
@@ -81,7 +105,7 @@ class ModelServer:
                                    booster=booster)
         if warm:
             warm_entry(entry)
-        self.obs.event("model_load", model=entry.label)
+        self._on_event("model_load", model=entry.label)
         return entry.label
 
     def swap(self, name: str, source: Any, *,
@@ -98,6 +122,89 @@ class ModelServer:
         return self._swapper.swap_async(
             name, source, version=version, booster=booster,
             drain_timeout_s=drain_timeout_s)
+
+    # ------------------------------------------------------------------
+    # crash-only restart: the resident-model manifest
+    # ------------------------------------------------------------------
+    def _write_manifest(self) -> None:
+        """Atomically persist name@version -> retained source under the
+        run_dir. ``raw`` sources (live Boosters) are spilled to
+        ``run_dir/models/<name>@v<N>.json`` once so they survive the
+        process; path-shaped sources are recorded as-is."""
+        if not self._run_root:
+            return
+        with self._manifest_lock:
+            models: Dict[str, Any] = {}
+            live = self.registry.models()
+            for (name, v), (kind, payload) in sorted(
+                    self.registry.sources_snapshot().items()):
+                if kind == "raw":
+                    mdir = os.path.join(self._run_root, "models")
+                    path = os.path.join(mdir, f"{name}@v{v}.json")
+                    try:
+                        if not os.path.exists(path):
+                            os.makedirs(mdir, exist_ok=True)
+                            tmp = f"{path}.tmp.{os.getpid()}"
+                            with open(tmp, "wb") as f:
+                                f.write(bytes(payload))
+                                f.flush()
+                                os.fsync(f.fileno())
+                            os.replace(tmp, path)
+                    except OSError:
+                        continue  # unspillable source: not restartable
+                    kind, payload = "file", path
+                doc = models.setdefault(
+                    name, {"live": live.get(name), "versions": {}})
+                doc["versions"][str(v)] = {"kind": kind, "path": payload}
+            _flight.atomic_write_json(
+                os.path.join(self._run_root, "manifest.json"),
+                {"format": MANIFEST_FORMAT, "pid": os.getpid(),
+                 "unix_ms": time.time() * 1e3, "models": models})
+
+    def _restore_manifest(self) -> None:
+        """Crash-only restart: re-register every manifest source LAZILY
+        (no booster builds, no compiles) — the first request per model
+        faults it in exactly like an LRU eviction would
+        (docs/serving.md "Failure handling")."""
+        path = os.path.join(self._run_root, "manifest.json")
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            return
+        if doc.get("format") != MANIFEST_FORMAT:
+            return
+        restored = 0
+        for name, info in doc.get("models", {}).items():
+            live_v = info.get("live")
+            for v_str, spec in info.get("versions", {}).items():
+                try:
+                    self.registry.register_source(
+                        name, int(v_str), (spec["kind"], spec["path"]),
+                        live=(live_v is not None
+                              and int(v_str) == int(live_v)))
+                    restored += 1
+                except (KeyError, TypeError, ValueError):
+                    continue  # one bad entry must not lose the rest
+        if restored:
+            self.obs.event("manifest_restore", models=restored)
+
+    # ------------------------------------------------------------------
+    def begin_drain(self) -> None:
+        """SIGTERM half of crash-only shutdown: stop admitting (new
+        requests shed with reason ``draining``) while everything already
+        admitted keeps flowing to completion; dump the black box now in
+        case the process is killed harder before :meth:`close`."""
+        if self._draining:
+            return
+        self._draining = True
+        self.admission.draining = True
+        self.obs.event("server_drain")
+        self.obs.dump("drain")
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
 
     # ------------------------------------------------------------------
     def predict_async(self, name: str, data, *,
@@ -153,6 +260,8 @@ class ModelServer:
             "queue_depth": self.batcher.queue_depth(),
             "p99_s": self.admission.p99_s(),
             "slo": self.obs.ledger.summary(),
+            "faults": self.faults.snapshot(),
+            "draining": self._draining,
         }
 
     def close(self, drain: bool = True) -> None:
@@ -308,24 +417,38 @@ def serve_main(argv: List[str], stdin=None, stdout=None) -> int:
         server.close()
         return 0
 
+    # in-flight protocol bookkeeping: the SIGTERM drain barrier must not
+    # exit the process while a handler thread still owes a response to a
+    # request it already read off its socket ("kill -TERM mid-traffic
+    # loses zero admitted requests")
+    inflight = {"n": 0}
+    inflight_cv = threading.Condition()
+
     class Handler(socketserver.StreamRequestHandler):
         def handle(self) -> None:
             for raw in self.rfile:
                 line = raw.decode("utf-8", "replace").strip()
                 if not line:
                     continue
+                with inflight_cv:
+                    inflight["n"] += 1
                 try:
-                    msg = json.loads(line)
-                except ValueError as e:
-                    out = {"error": f"bad json: {e}"}
-                else:
-                    out = _handle(server, msg, shutdown)
-                try:
-                    self.wfile.write(
-                        (json.dumps(out) + "\n").encode())
-                    self.wfile.flush()
-                except OSError:
-                    return  # client went away mid-response
+                    try:
+                        msg = json.loads(line)
+                    except ValueError as e:
+                        out = {"error": f"bad json: {e}"}
+                    else:
+                        out = _handle(server, msg, shutdown)
+                    try:
+                        self.wfile.write(
+                            (json.dumps(out) + "\n").encode())
+                        self.wfile.flush()
+                    except OSError:
+                        return  # client went away mid-response
+                finally:
+                    with inflight_cv:
+                        inflight["n"] -= 1
+                        inflight_cv.notify_all()
 
     class Srv(socketserver.ThreadingTCPServer):
         allow_reuse_address = True
@@ -335,6 +458,20 @@ def serve_main(argv: List[str], stdin=None, stdout=None) -> int:
 
     def shutdown() -> None:
         threading.Thread(target=tcp.shutdown, daemon=True).start()
+
+    # crash-only SIGTERM: stop admission, stop accepting, let the drain
+    # below flush the batcher within XGBTPU_DRAIN_DEADLINE_S, black-box
+    # dump, exit 0 (docs/serving.md "Failure handling"). Installable only
+    # from the main thread; embedded/test callers keep their own handling.
+    prev_term = None
+    try:
+        def _sigterm(signum, frame):
+            server.begin_drain()
+            shutdown()
+
+        prev_term = signal.signal(signal.SIGTERM, _sigterm)
+    except ValueError:
+        pass  # not the main thread
 
     host, port = tcp.server_address[:2]
     print(f"READY serving on {host}:{port} "
@@ -346,5 +483,21 @@ def serve_main(argv: List[str], stdin=None, stdout=None) -> int:
         pass
     finally:
         tcp.server_close()
+        # drain barrier: every request a handler thread already read gets
+        # its response before the process exits (new arrivals shed with
+        # reason "draining" once begin_drain ran, so this converges)
+        try:
+            deadline_s = float(
+                os.environ.get("XGBTPU_DRAIN_DEADLINE_S", "60") or 60)
+        except ValueError:
+            deadline_s = 60.0
+        with inflight_cv:
+            inflight_cv.wait_for(lambda: inflight["n"] == 0,
+                                 timeout=deadline_s)
         server.close()
+        if prev_term is not None:
+            try:
+                signal.signal(signal.SIGTERM, prev_term)
+            except ValueError:
+                pass
     return 0

@@ -58,7 +58,32 @@ def hot_swap(registry: ModelRegistry, name: str, source: Any, *,
     before draining; ``on_event(name, **args)`` (the serving flight
     recorder's hook) records the completed swap on the request timeline
     — from here rather than the server, so background ``swap_async``
-    flips land on the timeline too."""
+    flips land on the timeline too.
+
+    Failure containment: the whole sequence runs under the
+    ``serving_swap`` chaos/classification site. A swap that fails at any
+    stage before the flip leaves the OLD version serving untouched (the
+    pointer only moves on success); the failure is classified and
+    re-raised to the caller."""
+    from ..resilience import chaos
+
+    try:
+        chaos.hit("serving_swap")
+        return _hot_swap(registry, name, source, version=version,
+                         booster=booster, warm=warm,
+                         drain_timeout_s=drain_timeout_s,
+                         on_flip=on_flip, on_event=on_event)
+    except Exception as e:
+        from .faults import record_serving_fault
+
+        record_serving_fault("serving_swap", e)
+        raise
+
+
+def _hot_swap(registry: ModelRegistry, name: str, source: Any, *,
+              version: Optional[int] = None, booster=None,
+              warm: bool = True, drain_timeout_s: float = 60.0,
+              on_flip=None, on_event=None) -> ModelEntry:
     old_version = registry.live_version(name)
     entry = registry.load(name, source, version=version, booster=booster,
                           make_live=False)

@@ -72,7 +72,33 @@ def resolve_source(source: Any) -> Tuple[str, Any]:
 def load_booster(spec: Tuple[str, Any]):
     """A fresh ``Booster`` from a resolved source spec. Checkpoint kinds
     go through the resilience layer's verified readers, so a truncated or
-    bit-flipped snapshot is rejected (or fallen through) instead of served."""
+    bit-flipped snapshot is rejected (or fallen through) instead of served.
+
+    Every build runs under the ``serving_model_load`` retry/chaos site:
+    a transient read hiccup gets one bounded retry (``XGBTPU_RETRY``
+    site ``serving_model_load``), anything persistent is classified and
+    re-raised — an LRU fault-back-in that fails permanently surfaces to
+    the caller instead of crash-looping the arena."""
+    from ..resilience import chaos, policy
+
+    def _build():
+        chaos.hit("serving_model_load")
+        return _load_booster_from(spec)
+
+    try:
+        return policy.RetryPolicy("serving_model_load", retries=1).run(
+            _build)
+    except Exception as e:
+        # RetryPolicy already recorded faults_total{site,kind}; add only
+        # the serving-plane slice here (no double count)
+        REGISTRY.counter(
+            "serving_faults_total",
+            "Failures observed on the serving plane, by site and kind",
+        ).labels(site="serving_model_load", kind=policy.classify(e)).inc()
+        raise
+
+
+def _load_booster_from(spec: Tuple[str, Any]):
     from ..learner import Booster
     from ..resilience import checkpoint
 
@@ -282,6 +308,29 @@ class ModelRegistry:
         for label in evicted:
             self._on_event("model_evict", model=label)
         return entry
+
+    def register_source(self, name: str, version: int,
+                        spec: Tuple[str, Any], *,
+                        live: bool = False) -> None:
+        """Register a model source WITHOUT loading it — the crash-only
+        restart path (``docs/serving.md`` "Failure handling"): a server
+        restoring its persisted manifest registers every retained source
+        lazily, and the first request for each name faults the booster
+        back in exactly like an LRU eviction would."""
+        if spec[0] not in ("raw", "file", "ckpt", "ckpt_dir"):
+            raise ValueError(f"unknown source kind: {spec[0]!r}")
+        with self._lock:
+            self._sources[(name, int(version))] = (spec[0], spec[1])
+            self._next_version[name] = max(
+                int(version), self._next_version.get(name, 0))
+            if live:
+                self._live[name] = int(version)
+
+    def sources_snapshot(self) -> Dict[Tuple[str, int], Tuple[str, Any]]:
+        """Every retained (name, version) -> source spec — the manifest
+        writer's input."""
+        with self._lock:
+            return dict(self._sources)
 
     def set_live(self, name: str, version: int) -> ModelEntry:
         """Atomically flip the serving pointer (the entry must exist)."""
