@@ -450,6 +450,13 @@ def _predict_bench(xgb, X, y, args, suffix: str, final_predict: dict) -> None:
         print(f"# served bench failed ({type(e).__name__}: {e}); skipping",
               file=sys.stderr, flush=True)
 
+    if os.environ.get("XGBTPU_BENCH_ROUTED", "1") != "0":
+        try:
+            _routed_bench(bst, Xs)
+        except Exception as e:  # noqa: BLE001 — informational stage
+            print(f"# routed bench failed ({type(e).__name__}: {e}); "
+                  "skipping", file=sys.stderr, flush=True)
+
     name = (f"predict_inplace_{rows // 1000}kx{args.columns}_"
             f"{bst.num_boosted_rounds()}r{suffix}")
     ratio = round(rps_i / max(rps_d, 1e-9), 3)
@@ -562,6 +569,141 @@ def _served_bench(bst, Xs: np.ndarray, n_threads: int = 8,
                   "coalesce_ratio": round(coalesce, 2),
                   "dispatches": int(dispatches),
                   "stage_latency_ms": stage_ms})
+
+
+def _routed_bench(bst, Xs: np.ndarray, n_threads: int = 4,
+                  n_requests: int = 160) -> None:
+    """Routed-fleet stage (ISSUE 11 satellite): the PR-7 concurrent
+    ragged client stream through the consistent-hash router over TWO
+    in-process replicas vs the same stream sent directly to one replica
+    over the identical TCP JSONL protocol. Informational on this 1-core
+    container (router + replicas + clients share the core, so routed
+    throughput measures protocol overhead, not fleet scaling) —
+    PARITY-gated, not speed-gated: every routed answer must match
+    ``inplace_predict`` bit-for-float. Emits routed/direct rows/s and the
+    re-route count to stderr + the partial sidecar. ``XGBTPU_BENCH_ROUTED=0``
+    skips the stage (the tier-1 bench contract test does; the CI fleet
+    lane covers this path end-to-end)."""
+    import socket
+    import tempfile
+    import threading
+
+    from xgboost_tpu.observability import REGISTRY
+    from xgboost_tpu.serving.fleet import ReplicaEndpoint, Router
+    from xgboost_tpu.serving.fleet.supervisor import free_port
+    from xgboost_tpu.serving.server import serve_main
+
+    def counter(name):
+        fam = REGISTRY.get(name)
+        return 0.0 if fam is None else fam.labels().value
+
+    tmp = tempfile.mkdtemp(prefix="bench_fleet_")
+    mpath = os.path.join(tmp, "model.json")
+    bst.save_model(mpath)
+    manifest = os.path.join(tmp, "manifest.json")
+
+    ports = [free_port(), free_port()]
+    for k, port in enumerate(ports):
+        threading.Thread(target=serve_main, args=(
+            ["--port", str(port), "--model", f"bench={mpath}",
+             "--manifest", manifest, "--batch-wait-us", "500"],),
+            kwargs={"stdout": open(os.devnull, "w")}, daemon=True).start()
+    deadline = time.perf_counter() + 60
+    for port in ports:  # READY = the replica accepts and answers a ping
+        while True:
+            try:
+                with socket.create_connection(("127.0.0.1", port),
+                                              timeout=1) as c:
+                    c.sendall(b'{"op": "ping"}\n')
+                    if c.recv(1 << 12):
+                        break
+            except OSError:
+                if time.perf_counter() > deadline:
+                    raise RuntimeError("fleet replicas never came up")
+                time.sleep(0.1)
+
+    rng = np.random.RandomState(13)
+    reqs = [(int(lo), int(n)) for lo, n in zip(
+        rng.randint(0, max(1, Xs.shape[0] - 64), n_requests),
+        rng.randint(1, 65, n_requests))]
+    total_rows = sum(n for _, n in reqs)
+    ref = np.asarray(bst.inplace_predict(Xs), np.float64)
+
+    def stream(send):
+        """Drive the request stream from n_threads clients through
+        ``send(msg) -> response``; returns (seconds, worst parity)."""
+        errors, parity = [], [0.0]
+        shards = [reqs[k::n_threads] for k in range(n_threads)]
+
+        def client(shard):
+            try:
+                for lo, n in shard:
+                    r = send({"op": "predict", "model": "bench",
+                              "data": Xs[lo:lo + n].tolist(),
+                              "timeout_s": 120.0})
+                    if "result" not in r:
+                        errors.append(r)
+                        continue
+                    d = float(np.max(np.abs(
+                        np.asarray(r["result"], np.float64).ravel()
+                        - ref[lo:lo + n].ravel())))
+                    parity[0] = max(parity[0], d)
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                errors.append(repr(e))
+
+        threads = [threading.Thread(target=client, args=(s,))
+                   for s in shards]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        el = time.perf_counter() - t0
+        if errors:
+            raise RuntimeError(f"{len(errors)} routed requests failed: "
+                               f"{errors[0]}")
+        return el, parity[0]
+
+    router = Router(
+        [ReplicaEndpoint(f"r{k}", "127.0.0.1", p)
+         for k, p in enumerate(ports)], health_interval_s=0.25).start()
+    direct = ReplicaEndpoint("direct", "127.0.0.1", ports[0])
+    try:
+        # warm both paths (first-touch compiles must not skew either)
+        stream(lambda m: direct.rpc(m, 120.0))
+        r0 = counter("fleet_reroutes_total")
+        direct_s, parity_d = stream(lambda m: direct.rpc(m, 120.0))
+        routed_s, parity_r = stream(lambda m: router.handle(m))
+        reroutes = counter("fleet_reroutes_total") - r0
+    finally:
+        router.stop()
+        for port in ports:
+            try:
+                with socket.create_connection(("127.0.0.1", port),
+                                              timeout=5) as c:
+                    c.sendall(b'{"op": "shutdown"}\n')
+                    c.recv(1 << 12)
+            except OSError:
+                pass
+        direct.reset()
+    routed_rps = total_rows / max(routed_s, 1e-9)
+    direct_rps = total_rows / max(direct_s, 1e-9)
+    parity = max(parity_d, parity_r)
+    parity_ok = parity < 1e-6
+    print(f"# predict_routed_rows_per_s={routed_rps:,.0f} "
+          f"(direct single-server {direct_rps:,.0f} rows/s, "
+          f"{n_threads} threads, {n_requests} ragged reqs, 2 replicas, "
+          f"{reroutes:.0f} re-routes, parity {parity:.2e}"
+          + ("" if parity_ok else " PARITY FAILED") + ")",
+          file=sys.stderr, flush=True)
+    _log_partial({"config": "predict_routed",
+                  "metric": "predict_routed_rows_per_s",
+                  "value": round(routed_rps, 1) if parity_ok else 0.0,
+                  "direct_rows_per_s": round(direct_rps, 1),
+                  "threads": n_threads, "requests": n_requests,
+                  "rows": total_rows, "replicas": 2,
+                  "reroutes": int(reroutes),
+                  "parity": parity, "parity_ok": parity_ok})
 
 
 def _report_arithmetic_intensity() -> None:

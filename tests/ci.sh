@@ -496,6 +496,197 @@ finally:
 
 EOF
 
+echo "=== tier 1.8: fleet lane (2 replicas + router, SIGTERM mid-traffic) ==="
+# The fleet serving tier end to end (ISSUE 11): `serve-fleet` spawns 2
+# crash-only replicas sharing ONE manifest behind the consistent-hash
+# router. Multi-tenant concurrent clients stream through the router;
+# one replica is SIGTERMed MID-TRAFFIC — zero admitted requests may be
+# lost (drained requests answered, new ones re-routed to the healthy
+# replica within the health deadline, no client-visible error), the
+# supervisor must respawn the replica, and the respawned process must
+# re-serve BOTH models from the shared manifest alone (no --model
+# flags on restart). Then fleet serve-report must merge both replicas
+# into one report: per-replica rollup with the drain event, per-tenant
+# rollup, and a loadable fleet-wide Chrome trace.
+python - <<'EOF'
+import json, os, signal, socket, subprocess, sys, tempfile, threading, time
+
+import numpy as np
+
+import xgboost_tpu as xgb
+
+rng = np.random.RandomState(0)
+X = rng.randn(400, 5).astype(np.float32)
+y = (X[:, 0] > 0).astype(np.float32)
+params = {"objective": "binary:logistic", "max_depth": 3, "max_bin": 16,
+          "verbosity": 0}
+tmp = tempfile.mkdtemp(prefix="ci_fleet_")
+run_dir = os.path.join(tmp, "fleet")
+v1 = xgb.train(params, xgb.DMatrix(X, label=y), 3)
+v1_path = os.path.join(tmp, "v1.json"); v1.save_model(v1_path)
+ref = np.asarray(v1.inplace_predict(X[:4]), np.float64)
+
+s = socket.socket(); s.bind(("127.0.0.1", 0))
+port = s.getsockname()[1]; s.close()
+env = dict(os.environ)
+env["PYTHONPATH"] = os.getcwd() + os.pathsep + env.get("PYTHONPATH", "")
+env.pop("XGBTPU_TRACE", None)
+env.pop("XGBTPU_CHAOS", None)
+
+proc = subprocess.Popen(
+    [sys.executable, "-m", "xgboost_tpu", "serve-fleet",
+     "--port", str(port), "--replicas", "2", "--run-dir", run_dir,
+     "--model", f"m={v1_path}", "--model", f"m2={v1_path}",
+     "--batch-wait-us", "2000"],
+    env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+try:
+    ready = proc.stdout.readline()
+    assert ready.startswith("READY fleet"), ready
+    fleet = json.load(open(os.path.join(run_dir, "fleet.json")))
+    assert len(fleet["replicas"]) == 2 and all(
+        r["alive"] for r in fleet["replicas"]), fleet
+
+    def rpc(sock, obj):
+        sock.sendall((json.dumps(obj) + "\n").encode())
+        buf = b""
+        while not buf.endswith(b"\n"):
+            chunk = sock.recv(1 << 16)
+            if not chunk:
+                return None
+            buf += chunk
+        return json.loads(buf)
+
+    # phase A: concurrent multi-tenant traffic through the router
+    failures, ok_count = [], [0]
+    def traffic(k, per):
+        tenant = "hot" if k < 2 else "light"
+        c = socket.create_connection(("127.0.0.1", port), timeout=120)
+        try:
+            for i in range(per):
+                model = "m" if (k + i) % 2 == 0 else "m2"
+                lo = (k * 31 + i * 7) % 350
+                r = rpc(c, {"op": "predict", "id": f"p{k}-{i}",
+                            "model": model, "tenant": tenant,
+                            "data": X[lo:lo + 1 + (i % 3)].tolist(),
+                            "timeout_s": 120.0})
+                if r is None or "result" not in r \
+                        or r.get("request_id") != f"p{k}-{i}":
+                    failures.append((k, i, r))
+                else:
+                    ok_count[0] += 1
+        finally:
+            c.close()
+    threads = [threading.Thread(target=traffic, args=(k, 15))
+               for k in range(4)]
+    for t in threads: t.start()
+    for t in threads: t.join()
+    assert not failures, f"routed multi-tenant traffic failed: {failures[:3]}"
+    assert ok_count[0] == 60, ok_count
+
+    # phase B: SIGTERM one replica MID-TRAFFIC — zero admitted lost.
+    # Kill the consistent-hash OWNER of "m" so the wave's requests are
+    # the ones that must re-route (the ring is deterministic, so the
+    # owner is computable here)
+    from xgboost_tpu.serving.fleet import HashRing
+    owner = HashRing(["r0", "r1"]).lookup("m")
+    victim = next(r for r in fleet["replicas"] if r["replica"] == owner)
+    victim_idx = int(owner[1:])
+    wave_fail, wave_ok, killed = [], [0], threading.Event()
+    def wave():
+        c = socket.create_connection(("127.0.0.1", port), timeout=120)
+        try:
+            for i in range(160):
+                r = rpc(c, {"op": "predict", "id": f"w-{i}", "model": "m",
+                            "tenant": "light", "data": X[:2].tolist(),
+                            "timeout_s": 120.0})
+                if r is None or "result" not in r:
+                    wave_fail.append((i, r))
+                else:
+                    wave_ok[0] += 1
+                if wave_ok[0] >= 20 and not killed.is_set():
+                    os.kill(victim["pid"], signal.SIGTERM)
+                    killed.set()
+                time.sleep(0.01)
+        finally:
+            c.close()
+    wt = threading.Thread(target=wave); wt.start(); wt.join(timeout=300)
+    assert killed.is_set(), "wave never reached 20 oks"
+    assert not wave_fail, \
+        f"admitted/re-routed requests lost across SIGTERM: {wave_fail[:3]}"
+    assert wave_ok[0] == 160, wave_ok
+
+    # the supervisor must respawn the victim (crash-only: SIGTERM from
+    # outside is an unplanned exit) with a fresh generation
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        fleet2 = json.load(open(os.path.join(run_dir, "fleet.json")))
+        r0 = fleet2["replicas"][victim_idx]
+        if r0["pid"] != victim["pid"] and r0["alive"] \
+                and r0["generation"] >= 1:
+            break
+        time.sleep(0.25)
+    else:
+        raise AssertionError(f"replica never respawned: {fleet2}")
+
+    # the respawned replica re-serves BOTH models from the shared
+    # manifest alone (its restart command has no --model flags)
+    c0 = socket.create_connection(("127.0.0.1", r0["port"]), timeout=120)
+    for model in ("m", "m2"):
+        r = rpc(c0, {"op": "predict", "model": model,
+                     "data": X[:4].tolist(), "timeout_s": 120.0})
+        assert r and np.allclose(r["result"], ref, atol=1e-6), (model, r)
+    c0.close()
+
+    # router metrics: the re-route and the health transition are visible
+    ctl = socket.create_connection(("127.0.0.1", port), timeout=120)
+    exp = rpc(ctl, {"op": "metrics"})["metrics"]
+    assert "fleet_reroutes_total" in exp
+    reroutes = [ln for ln in exp.splitlines()
+                if ln.startswith("fleet_reroutes_total")]
+    assert reroutes and float(reroutes[0].rsplit(" ", 1)[1]) >= 1, reroutes
+    assert f'fleet_replica_healthy{{replica="{owner}"}} 1' in exp, \
+        [ln for ln in exp.splitlines() if "healthy" in ln]
+    assert "fleet_replica_restarts_total 1" in exp
+    st = rpc(ctl, {"op": "stats"})["stats"]
+    assert len(st["replicas"]) == 2 and all(
+        r["healthy"] for r in st["replicas"]), st
+    rpc(ctl, {"op": "shutdown"}); ctl.close()
+    rc = proc.wait(timeout=180)
+    assert rc == 0, f"serve-fleet exited {rc}"
+    print(f"fleet lane OK: 60 multi-tenant + {wave_ok[0]} wave requests, "
+          "0 lost across SIGTERM, re-route + respawn + manifest re-serve")
+finally:
+    if proc.poll() is None:
+        proc.kill()
+
+# fleet serve-report: ONE report over both replicas' obs sinks
+import io
+from contextlib import redirect_stdout
+from xgboost_tpu.cli import cli_main
+from xgboost_tpu.observability import load_trace
+
+buf = io.StringIO()
+with redirect_stdout(buf):
+    rc = cli_main(["serve-report", run_dir])
+out = buf.getvalue()
+assert rc == 0, f"fleet serve-report failed (rc={rc}):\n{out}"
+assert "fleet serve-report (2 replicas)" in out, out
+assert "per-replica rollup" in out and "replica0" in out \
+    and "replica1" in out, out
+assert "server_drain" in out, out  # the SIGTERM drain event, inlined
+assert "per-tenant rollup" in out and "hot" in out and "light" in out, out
+merged = load_trace(os.path.join(run_dir, "obs", "fleet_serve.trace.json"))
+assert merged, "empty fleet trace"
+pids = {e.get("pid") for e in merged}
+assert {0, 1} <= pids, f"both replicas must be in the fleet trace: {pids}"
+rep = json.load(open(os.path.join(run_dir, "obs",
+                                  "fleet_serve_report.json")))
+assert {r["replica"] for r in rep["replicas"]} == {"replica0", "replica1"}
+assert "light" in rep["tenants"] and "hot" in rep["tenants"], rep["tenants"]
+print(f"fleet serve-report OK: {len(merged)} merged events, "
+      f"{len(rep['replicas'])} replicas, tenants {sorted(rep['tenants'])}")
+EOF
+
 echo "=== tier 2: trace parses as Chrome trace JSON ==="
 # load_trace raises on malformed output; trace-report exits nonzero
 python -m xgboost_tpu trace-report "$TRACE_OUT" > /dev/null

@@ -33,6 +33,10 @@ def test_bench_produces_json_lines():
     # cost-analysis compiles (tier-1 time budget; tests/test_flight.py
     # covers the export itself)
     env["XGBTPU_COST_ANALYSIS"] = "0"
+    # and skip the routed-fleet stage (2 in-process replicas + router):
+    # informational partial-only output, covered end-to-end by the CI
+    # tier-1.8 fleet lane and tests/test_fleet.py
+    env["XGBTPU_BENCH_ROUTED"] = "0"
     # contract-sized workload (was 20k x 8r: ~75s of 1-core tier-1
     # budget). 12k rows is the floor where the native walker's >= 3x
     # serving bar still holds (measured 3.4x at 12k vs 2.7x at 6k —

@@ -5,11 +5,13 @@ key=value config parser (``src/common/config.h``). Usage:
 
     python -m xgboost_tpu <config> [key=value ...]
     python -m xgboost_tpu trace-report <trace-file|glob> ... [--top N]
-    python -m xgboost_tpu obs-report <run_dir> [--top-rounds N]
-    python -m xgboost_tpu serve-report <run_dir> [--top N]
+    python -m xgboost_tpu obs-report <run_dir> ... [--top-rounds N]
+    python -m xgboost_tpu serve-report <run_dir> ... [--top N]
     python -m xgboost_tpu checkpoint-inspect <dir>
     python -m xgboost_tpu serve (--port N | --stdin) [--model name=path ...]
-        [--run-dir D]
+        [--run-dir D] [--manifest F]
+    python -m xgboost_tpu serve-fleet --port N --run-dir D [--replicas K]
+        [--model name=path ...]
 
 Config keys mirror the reference: task, data, test:data, model_in,
 model_out, model_dir, num_round, save_period, eval[name]=path, dump_format,
@@ -24,7 +26,13 @@ sibling: it merges a model server's ``run_dir/obs/server/`` access log,
 dispatch flight ring and request trace into per-model latency
 percentiles, a shed/degrade timeline, coalescing stats and a
 worst-request exemplar table (``observability/serve_report.py``,
-docs/serving.md "Tracing a request").
+docs/serving.md "Tracing a request"). Both reports accept MULTIPLE
+run_dirs — and a fleet run_dir with ``replica<k>/`` subdirs expands to
+every replica — merging into one fleet-wide trace and a per-replica /
+per-tenant rollup (docs/serving.md "Scaling out"). ``serve-fleet`` runs
+that fleet: N supervised crash-only ``serve`` replicas sharing one
+manifest behind the consistent-hash routing front
+(``serving/fleet/``).
 ``lint`` runs the static-analysis gate (trace-safety / retrace / dtype /
 concurrency passes, ``docs/static_analysis.md``):
 
@@ -113,6 +121,10 @@ def cli_main(argv: List[str]) -> int:
         from .serving.server import serve_main
 
         return serve_main(argv[1:])
+    if argv[0] == "serve-fleet":
+        from .serving.fleet.supervisor import serve_fleet_main
+
+        return serve_fleet_main(argv[1:])
     pairs = parse_config_file(argv[0])
     for extra in argv[1:]:
         k, _, v = extra.partition("=")

@@ -45,14 +45,18 @@ __all__ = ["collect", "load_obs_dir", "merge_trace", "write_trace",
            "rollup_metrics", "fleet_table", "format_fleet_report", "main"]
 
 _RANK_RE = re.compile(r"^rank(\d+)$")
+_REPLICA_RE = re.compile(r"^replica(\d+)$")
 
 
 class RankObs:
-    """One rank's persisted observability files, parsed leniently."""
+    """One rank's persisted observability files, parsed leniently.
+    ``title`` names the merged-trace process lane (defaults to the rank;
+    multi-run merges and fleet replicas override it)."""
 
-    def __init__(self, rank: int, path: str):
+    def __init__(self, rank: int, path: str, title: Optional[str] = None):
         self.rank = rank
         self.path = path
+        self.title = title if title is not None else f"rank {rank}"
         self.clock_unix_ns: Optional[int] = None
         self.trace_events: List[Dict[str, Any]] = []
         self.flight: List[Dict[str, Any]] = []
@@ -127,29 +131,46 @@ class RankObs:
         return out
 
 
-def load_obs_dir(path: str, rank: int = 0) -> RankObs:
+def load_obs_dir(path: str, rank: int = 0,
+                 title: Optional[str] = None) -> RankObs:
     """Load ONE observability directory outside the ``rank<k>`` naming —
     the loader is layout-generic (flight/trace/metrics/clock sidecars),
     so the serving plane's ``obs/server/`` directory (``serve-report``,
     ``observability/serve_report.py``) reuses the same lenient parse and
     the same clock-aligned ``merge_trace``/``rollup_metrics`` machinery
     as a training rank. ``rank`` becomes the Chrome ``pid``."""
-    return RankObs(rank, path).load()
+    return RankObs(rank, path, title).load()
 
 
 def collect(run_dir: str) -> List[RankObs]:
-    """Every ``rank<k>`` directory under ``run_dir/obs``, loaded."""
-    obs = os.path.join(run_dir, "obs")
+    """Every ``rank<k>`` directory under ``run_dir/obs``, loaded — plus,
+    for a *fleet* run_dir (``serve-fleet``), every
+    ``replica<k>/obs/server`` serving sink as a rank-shaped member, so
+    ``obs-report`` on a fleet directory rolls N replicas' metrics and
+    traces up exactly like N training ranks (ISSUE 11)."""
     ranks: List[RankObs] = []
+    obs = os.path.join(run_dir, "obs")
     try:
         names = sorted(os.listdir(obs))
     except OSError:
-        return ranks
+        names = []
     for name in names:
         m = _RANK_RE.match(name)
         sub = os.path.join(obs, name)
         if m and os.path.isdir(sub):
             ranks.append(RankObs(int(m.group(1)), sub).load())
+    try:
+        top = sorted(os.listdir(run_dir))
+    except OSError:
+        top = []
+    # replicas slot in after any training ranks so pids never collide
+    base = max((r.rank for r in ranks), default=-1) + 1 if ranks else 0
+    for name in top:
+        m = _REPLICA_RE.match(name)
+        sub = os.path.join(run_dir, name, "obs", "server")
+        if m and os.path.isdir(sub):
+            ranks.append(RankObs(base + int(m.group(1)), sub,
+                                 title=name).load())
     return sorted(ranks, key=lambda r: r.rank)
 
 
@@ -170,7 +191,7 @@ def merge_trace(ranks: List[RankObs]) -> List[Dict[str, Any]]:
     for r in ranks:
         merged.append({
             "name": "process_name", "ph": "M", "pid": r.rank, "tid": 0,
-            "args": {"name": f"xgboost_tpu rank {r.rank}"},
+            "args": {"name": f"xgboost_tpu {r.title}"},
         })
         shift_us = 0
         if r.clock_unix_ns is not None and anchor_ns:
@@ -346,7 +367,7 @@ def format_fleet_report(ranks: List[RankObs], rollup: Dict[str, Any],
         n_rounds = sum(1 for rec in r.flight if rec.get("t") == "round")
         n_events = sum(1 for rec in r.flight if rec.get("t") == "event")
         lines.append(
-            f"  rank {r.rank}: {n_rounds} round records, {n_events} "
+            f"  {r.title}: {n_rounds} round records, {n_events} "
             f"events, {len(r.trace_events)} trace events"
             + (f", {len(r.errors)} parse errors" if r.errors else ""))
         for err in r.errors:
@@ -407,7 +428,7 @@ def format_fleet_report(ranks: List[RankObs], rollup: Dict[str, Any],
 
 
 def main(argv: List[str]) -> int:
-    usage = ("usage: python -m xgboost_tpu obs-report <run_dir> "
+    usage = ("usage: python -m xgboost_tpu obs-report <run_dir> ... "
              "[--top-rounds N]")
     if not argv or argv[0] in ("-h", "--help"):
         print(usage, file=sys.stderr)
@@ -421,11 +442,24 @@ def main(argv: List[str]) -> int:
             print(usage, file=sys.stderr)
             return 1
         argv = argv[:i] + argv[i + 2:]
-    run_dir = argv[0]
-    ranks = collect(run_dir)
+    # multiple run_dirs merge into ONE report (ISSUE 11): each dir's
+    # ranks keep their own pid block (dir index * 100 + rank) and carry
+    # the dir name in their lane title; outputs land under the FIRST dir
+    run_dirs = argv
+    run_dir = run_dirs[0]
+    ranks: List[RankObs] = []
+    for i, d in enumerate(run_dirs):
+        sub = collect(d)
+        for r in sub:
+            if len(run_dirs) > 1:
+                label = os.path.basename(os.path.normpath(d)) or d
+                r.title = f"{label} {r.title}"
+                r.rank += i * 100
+        ranks.extend(sub)
     if not ranks:
-        print(f"{run_dir}: no obs/rank<k> directories found (was the run "
-              "launched with a flight-recorder sink? docs/observability.md)",
+        print(f"{' '.join(run_dirs)}: no obs/rank<k> (or replica<k>/obs/"
+              "server) directories found (was the run launched with a "
+              "flight-recorder sink? docs/observability.md)",
               file=sys.stderr)
         return 1
     merged = merge_trace(ranks)

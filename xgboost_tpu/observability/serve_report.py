@@ -34,14 +34,17 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import sys
 from collections import defaultdict
 from typing import Any, Dict, List, Optional, Tuple
 
 from . import fleet
 
-__all__ = ["load_server_obs", "summarize_access", "format_serve_report",
-           "main"]
+__all__ = ["load_server_obs", "summarize_access", "summarize_tenants",
+           "format_serve_report", "expand_server_dirs", "main"]
+
+_REPLICA_RE = re.compile(r"^replica(\d+)$")
 
 
 def _resolve_dir(path: str) -> Optional[str]:
@@ -53,6 +56,31 @@ def _resolve_dir(path: str) -> Optional[str]:
                 or os.path.isfile(os.path.join(cand, "flight.jsonl")):
             return cand
     return None
+
+
+def expand_server_dirs(paths: List[str]) -> List[Tuple[str, str]]:
+    """(label, server-obs dir) for every serving sink named by ``paths``:
+    each path may be a single server run_dir (label = its basename) OR a
+    fleet run_dir whose ``replica<k>/`` children each hold one
+    (labels ``replica<k>``) — the ``serve-fleet`` layout."""
+    entries: List[Tuple[str, str]] = []
+    for p in paths:
+        d = _resolve_dir(p)
+        if d is not None:
+            entries.append(
+                (os.path.basename(os.path.normpath(p)) or p, d))
+            continue
+        try:
+            names = os.listdir(p)
+        except OSError:
+            continue
+        matches = [(int(m.group(1)), name) for name, m in
+                   ((n, _REPLICA_RE.match(n)) for n in names) if m]
+        for _, name in sorted(matches):  # numeric: replica2 < replica10
+            sub = _resolve_dir(os.path.join(p, name))
+            if sub is not None:
+                entries.append((name, sub))
+    return entries
 
 
 def load_server_obs(path: str) -> Optional[Tuple[Any, List[Dict[str, Any]]]]:
@@ -122,6 +150,35 @@ def summarize_access(access: List[Dict[str, Any]],
     }
 
 
+def summarize_tenants(access: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Per request-tenant rollup from access lines (requests that carried
+    no tenant group under ``-``): counts, shed reasons, exact total-time
+    and queue-wait percentiles — the fairness story per tenant, fleet-wide
+    when the access set spans replicas (ISSUE 11)."""
+    per: Dict[str, List[Dict[str, Any]]] = defaultdict(list)
+    for rec in access:
+        per[rec.get("tenant") or "-"].append(rec)
+    out: Dict[str, Any] = {}
+    for tenant, recs in sorted(per.items()):
+        ok = [r for r in recs if r.get("outcome") == "ok"]
+        totals = sorted(r.get("total_s", 0.0) for r in ok)
+        queues = sorted(r["queue_wait_s"] for r in ok
+                        if "queue_wait_s" in r)
+        sheds: Dict[str, int] = defaultdict(int)
+        for r in recs:
+            if r.get("shed"):
+                sheds[r["shed"]] += 1
+        out[tenant] = {
+            "requests": len(recs), "ok": len(ok),
+            "rows": sum(int(r.get("rows", 0)) for r in ok),
+            "total_p50_s": _pct(totals, 0.50),
+            "total_p99_s": _pct(totals, 0.99),
+            "queue_wait_p99_s": _pct(queues, 0.99),
+            "shed_reasons": dict(sheds),
+        }
+    return out
+
+
 def _timeline(access: List[Dict[str, Any]],
               events: List[Dict[str, Any]],
               dispatches: List[Dict[str, Any]],
@@ -171,12 +228,17 @@ def _timeline(access: List[Dict[str, Any]],
 def format_serve_report(summary: Dict[str, Any],
                         timeline: List[Dict[str, Any]],
                         exemplars: List[Dict[str, Any]],
-                        top: int = 8) -> str:
+                        top: int = 8,
+                        tenants: Optional[Dict[str, Any]] = None,
+                        replicas: Optional[List[Dict[str, Any]]] = None
+                        ) -> str:
     o = summary["outcomes"]
     shed_detail = ",".join(f"{k}={v}" for k, v in
                            sorted(summary["shed_reasons"].items()))
+    head = "serve-report" if not replicas \
+        else f"fleet serve-report ({len(replicas)} replicas)"
     lines = [
-        f"serve-report: {summary['requests']} request(s) — "
+        f"{head}: {summary['requests']} request(s) — "
         f"ok={o.get('ok', 0)} shed={o.get('shed', 0)}"
         + (f" ({shed_detail})" if shed_detail else "")
         + f" error={o.get('error', 0)}",
@@ -187,6 +249,21 @@ def format_serve_report(summary: Dict[str, Any],
         + (" ".join(f"{k}={v}" for k, v in
                     sorted(summary["routes"].items())) or "none"),
     ]
+    if replicas:
+        lines.append("")
+        lines.append("per-replica rollup:")
+        lines.append(f"  {'replica':<14} {'n':>6} {'ok':>6} {'shed':>5} "
+                     f"{'err':>4} {'p50':>10} {'p99':>10} {'burn':>6}  "
+                     "events")
+        for r in replicas:
+            evs = ",".join(f"{k}={v}" for k, v in
+                           sorted(r.get("events", {}).items()))
+            lines.append(
+                f"  {r['replica']:<14} {r['requests']:>6} {r['ok']:>6} "
+                f"{r['shed']:>5} {r['error']:>4} "
+                f"{r['total_p50_s'] * 1e3:>8.2f}ms "
+                f"{r['total_p99_s'] * 1e3:>8.2f}ms "
+                f"{r.get('burn', 0.0):>6.2f}  {evs}")
     if summary["models"]:
         lines.append("")
         lines.append("per-model latency (access log, completed requests):")
@@ -201,6 +278,19 @@ def format_serve_report(summary: Dict[str, Any],
                 f"{m['total_max_s'] * 1e3:>8.2f}ms "
                 f"{m['queue_wait_p99_s'] * 1e3:>8.2f}ms "
                 f"{m['dispatch_p99_s'] * 1e3:>8.2f}ms")
+    if tenants and (len(tenants) > 1 or "-" not in tenants):
+        lines.append("")
+        lines.append("per-tenant rollup (access log):")
+        lines.append(f"  {'tenant':<14} {'n':>6} {'ok':>6} {'rows':>7} "
+                     f"{'p50':>10} {'p99':>10} {'queue p99':>10}  sheds")
+        for tenant, t in tenants.items():
+            sheds = ",".join(f"{k}={v}" for k, v in
+                             sorted(t["shed_reasons"].items()))
+            lines.append(
+                f"  {tenant:<14} {t['requests']:>6} {t['ok']:>6} "
+                f"{t['rows']:>7} {t['total_p50_s'] * 1e3:>8.2f}ms "
+                f"{t['total_p99_s'] * 1e3:>8.2f}ms "
+                f"{t['queue_wait_p99_s'] * 1e3:>8.2f}ms  {sheds}")
     if timeline:
         lines.append("")
         lines.append("shed/degrade timeline (1s buckets):")
@@ -232,8 +322,22 @@ def format_serve_report(summary: Dict[str, Any],
     return "\n".join(lines)
 
 
+def _replica_burn(obs: Any) -> float:
+    """The replica's last-persisted error-budget burn gauge (0.0 when the
+    snapshot never landed)."""
+    fam = (obs.metrics or {}).get("serving_error_budget_burn")
+    if not isinstance(fam, dict):
+        return 0.0
+    for s in fam.get("series", []):
+        if not s.get("labels"):
+            return float(s.get("value", 0.0))
+    return 0.0
+
+
 def main(argv: List[str]) -> int:
-    usage = ("usage: python -m xgboost_tpu serve-report <dir> [--top N]")
+    usage = ("usage: python -m xgboost_tpu serve-report <dir> ... "
+             "[--top N]  (a dir may be one server run_dir or a fleet "
+             "run_dir with replica<k>/ children)")
     if not argv or argv[0] in ("-h", "--help"):
         print(usage, file=sys.stderr)
         return 0 if argv else 1
@@ -246,35 +350,87 @@ def main(argv: List[str]) -> int:
             print(usage, file=sys.stderr)
             return 1
         argv = argv[:i] + argv[i + 2:]
-    loaded = load_server_obs(argv[0])
-    if loaded is None:
-        print(f"{argv[0]}: no serving observability found (launch the "
-              "server with run_dir= / --run-dir / XGBTPU_SERVE_DIR — "
-              "docs/serving.md \"Tracing a request\")", file=sys.stderr)
+    entries = expand_server_dirs(argv)
+    if not entries:
+        print(f"{' '.join(argv)}: no serving observability found (launch "
+              "the server with run_dir= / --run-dir / XGBTPU_SERVE_DIR, "
+              "or point at a serve-fleet run_dir — docs/serving.md "
+              "\"Tracing a request\", \"Scaling out\")", file=sys.stderr)
         return 1
-    obs, access = loaded
-    for err in obs.errors:
-        print(f"serve-report: {err}", file=sys.stderr)
-    events = [r for r in obs.flight if r.get("t") == "event"]
-    dispatches = [r for r in obs.flight if r.get("t") == "dispatch"]
+    fleet_mode = len(entries) > 1
+    all_obs, access, replicas = [], [], []
+    events: List[Dict[str, Any]] = []
+    dispatches: List[Dict[str, Any]] = []
+    for k, (label, d) in enumerate(entries):
+        obs = fleet.load_obs_dir(d, rank=k, title=label)
+        for err in obs.errors:
+            print(f"serve-report: {label}: {err}", file=sys.stderr)
+        acc = [rec for rec in obs._read_jsonl(
+            os.path.join(d, "access.jsonl")) if rec.get("t") == "req"]
+        evs = [r for r in obs.flight if r.get("t") == "event"]
+        dis = [r for r in obs.flight if r.get("t") == "dispatch"]
+        if fleet_mode:
+            for rec in acc:
+                rec["replica"] = label
+            for rec in evs:
+                rec.setdefault("args", {})["replica"] = label
+            rsum = summarize_access(acc, dis)
+            o = rsum["outcomes"]
+            totals = sorted(r.get("total_s", 0.0) for r in acc
+                            if r.get("outcome") == "ok")
+            replicas.append({
+                "replica": label, "requests": rsum["requests"],
+                "ok": o.get("ok", 0), "shed": o.get("shed", 0),
+                "error": o.get("error", 0),
+                "total_p50_s": _pct(totals, 0.50),
+                "total_p99_s": _pct(totals, 0.99),
+                "shed_reasons": rsum["shed_reasons"],
+                "burn": _replica_burn(obs),
+                "events": {name: sum(1 for e in evs
+                                     if e.get("name") == name)
+                           for name in sorted({e.get("name", "?")
+                                               for e in evs})},
+            })
+        all_obs.append(obs)
+        access.extend(acc)
+        events.extend(evs)
+        dispatches.extend(dis)
     summary = summarize_access(access, dispatches)
+    tenants = summarize_tenants(access)
     timeline = _timeline(access, events, dispatches)
     exemplars = sorted((r for r in access if "total_s" in r),
                        key=lambda r: -r["total_s"])
-    print(format_serve_report(summary, timeline, exemplars, top=top))
+    print(format_serve_report(summary, timeline, exemplars, top=top,
+                              tenants=tenants,
+                              replicas=replicas if fleet_mode else None))
 
-    obs_dir = os.path.dirname(obs.path)
-    trace_out = os.path.join(obs_dir, "serve.trace.json")
-    report_out = os.path.join(obs_dir, "serve_report.json")
+    if fleet_mode:
+        # one fleet-wide artifact set under the FIRST input's obs/ dir
+        obs_dir = os.path.join(argv[0], "obs")
+        try:
+            os.makedirs(obs_dir, exist_ok=True)
+        except OSError:
+            obs_dir = os.path.dirname(all_obs[0].path)
+        trace_out = os.path.join(obs_dir, "fleet_serve.trace.json")
+        report_out = os.path.join(obs_dir, "fleet_serve_report.json")
+        doc = {"summary": summary, "replicas": replicas,
+               "tenants": tenants, "timeline": timeline,
+               "exemplars": exemplars[:top],
+               "rollup": fleet.rollup_metrics(all_obs)}
+    else:
+        obs_dir = os.path.dirname(all_obs[0].path)
+        trace_out = os.path.join(obs_dir, "serve.trace.json")
+        report_out = os.path.join(obs_dir, "serve_report.json")
+        doc = {"summary": summary, "tenants": tenants,
+               "timeline": timeline, "exemplars": exemplars[:top]}
     try:
-        fleet.write_trace(trace_out, fleet.merge_trace([obs]))
+        fleet.write_trace(trace_out, fleet.merge_trace(all_obs))
         with open(report_out, "w") as f:
-            json.dump({"summary": summary, "timeline": timeline,
-                       "exemplars": exemplars[:top]}, f, default=str)
+            json.dump(doc, f, default=str)
     except OSError as e:
         print(f"serve-report: cannot write outputs: {e}", file=sys.stderr)
         return 1
-    n_spans = len(obs.trace_events)
+    n_spans = sum(len(o.trace_events) for o in all_obs)
     print(f"\nmerged trace -> {trace_out} ({n_spans} span events)")
     print(f"summary -> {report_out}")
     return 0

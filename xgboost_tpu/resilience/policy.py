@@ -36,7 +36,7 @@ from typing import Callable, Optional, Sequence, Tuple
 __all__ = [
     "TRANSIENT", "RESOURCE", "PERMANENT", "KINDS",
     "classify", "record_failure", "retry_budget", "RetryPolicy",
-    "is_worker_loss",
+    "is_worker_loss", "should_reroute",
 ]
 
 TRANSIENT = "transient"
@@ -85,6 +85,22 @@ def is_worker_loss(exc: BaseException) -> bool:
         return True
     msg = str(exc).lower()
     return any(t in msg for t in _WORKER_LOSS_SUBSTRINGS)
+
+
+def should_reroute(exc: BaseException) -> bool:
+    """The serving-fleet verdict for a request that failed *in transit*
+    to a replica (``serving/fleet/router.py``): True when the failure
+    reads as a lost or draining peer — a bare connection exception type
+    (reset / refused / broken pipe / EOF mid-response), a socket timeout,
+    or any :func:`is_worker_loss` message signature. The router then
+    retries the request ONCE on a healthy replica: predict requests are
+    idempotent, so a re-route can duplicate work but never corrupt an
+    answer. Failures the *replica itself* reported (a typed RequestError,
+    a shed) ride the response line and are never re-routed — the replica
+    is alive and already classified them."""
+    if isinstance(exc, (ConnectionError, EOFError, TimeoutError)):
+        return True
+    return is_worker_loss(exc)
 
 
 def classify(exc: BaseException) -> str:

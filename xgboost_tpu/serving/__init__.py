@@ -25,12 +25,18 @@ the serving-side analog of the reference's bindings/frontends tier
   ``RequestError`` for exactly the poison members), per-model circuit
   breakers, input quarantine, the batcher-worker watchdog, and the
   crash-only manifest/SIGTERM-drain contract (docs/serving.md
-  "Failure handling").
+  "Failure handling");
+- :mod:`~xgboost_tpu.serving.fleet` — the scale-out tier (ISSUE 11):
+  replica supervisor + consistent-hash routing front over N crash-only
+  servers sharing one versioned manifest, with weighted-fair multi-
+  tenant queuing (``tenancy.TenantFairQueue``) and per-tenant admission
+  quotas in every replica (docs/serving.md "Scaling out").
 
 Entry points: :class:`ModelServer` (``xgb.ModelServer``) in Python,
-``python -m xgboost_tpu serve`` for the JSONL stdin/socket protocol.
+``python -m xgboost_tpu serve`` for the JSONL stdin/socket protocol,
+``python -m xgboost_tpu serve-fleet`` for the replicated tier.
 Full walkthrough: docs/serving.md ("The model server", "Tracing a
-request").
+request", "Scaling out").
 """
 
 from .admission import AdmissionController, RequestShed  # noqa: F401
@@ -41,11 +47,13 @@ from .faults import (  # noqa: F401
 from .obs import ServingRecorder, SLOLedger  # noqa: F401
 from .server import ModelServer, serve_main  # noqa: F401
 from .swap import hot_swap  # noqa: F401
-from .tenancy import ModelEntry, ModelRegistry  # noqa: F401
+from .tenancy import (  # noqa: F401
+    ModelEntry, ModelRegistry, TenantFairQueue,
+)
 
 __all__ = [
     "AdmissionController", "CircuitBreaker", "FaultDomain", "MicroBatcher",
     "ModelEntry", "ModelRegistry", "ModelServer", "Quarantine",
     "RequestError", "RequestShed", "SLOLedger", "ServingRecorder",
-    "hot_swap", "serve_main",
+    "TenantFairQueue", "hot_swap", "serve_main",
 ]

@@ -15,6 +15,14 @@ queues). This module is the decision layer in front of the micro-batcher:
 - **queue bound** — the request queue is bounded
   (``XGBTPU_SERVING_QUEUE``, default 1024); overflow sheds with reason
   ``queue_full`` rather than growing the heap.
+- **tenant quota** (ISSUE 11) — each request tenant's *queue occupancy*
+  is bounded by ``XGBTPU_TENANT_QUOTA`` (``name=N,*=M`` or a bare int;
+  unset = unbounded; parsed once at construction like every other knob). A tenant at its quota sheds with reason
+  ``tenant_quota`` while every other tenant keeps admitting — set any
+  quota below the global queue bound and one hot tenant can no longer
+  cause a single ``queue_full`` shed for anyone else (the fairness
+  acceptance pin; the dequeue-side half is
+  :class:`~xgboost_tpu.serving.tenancy.TenantFairQueue`).
 - **degrade routing** — when the resilience layer marks the device predict
   path unhealthy (``degrade.worst("pallas_predict")`` != HEALTHY), the
   admission verdict routes dispatches to the native CPU SoA walker
@@ -45,6 +53,7 @@ from typing import Optional
 from ..observability.metrics import REGISTRY
 from ..resilience import degrade
 from .faults import FaultDomain
+from .tenancy import tenant_quotas
 
 __all__ = ["RequestShed", "AdmissionController"]
 
@@ -56,6 +65,7 @@ BREAKER = "breaker"  # the model's circuit breaker is OPEN
 QUARANTINE = "quarantine"  # repeat poison offender fingerprint
 INVALID = "invalid"  # malformed payload rejected at admission
 DRAINING = "draining"  # SIGTERM drain in progress
+TENANT_QUOTA = "tenant_quota"  # the tenant's queue-occupancy cap is hit
 
 #: p99 prior (seconds) used before the latency histogram has samples: a
 #: generous whole-bucket-walk estimate so a cold server does not shed its
@@ -95,13 +105,15 @@ class AdmissionController:
         self.faults = faults if faults is not None else FaultDomain()
         #: SIGTERM drain flag (set via the owning server's begin_drain)
         self.draining = False
+        #: XGBTPU_TENANT_QUOTA, parsed ONCE (admit runs per request)
+        self.quotas = tenant_quotas()
         # pre-create the families so a healthy server's exposition still
         # documents the shed/admit surface (scrapers see zeros, not gaps)
         self._shed = REGISTRY.counter(
             "requests_shed_total",
             "Requests declined by SLO-aware admission, by reason")
         for reason in (QUEUE_FULL, DEADLINE, SLO, BREAKER, QUARANTINE,
-                       INVALID, DRAINING):
+                       INVALID, DRAINING, TENANT_QUOTA):
             self._shed.labels(reason=reason)
         self._admitted = REGISTRY.counter(
             "serving_admitted_total", "Requests admitted into the batcher")
@@ -140,15 +152,26 @@ class AdmissionController:
     def admit(self, queue_depth: int,
               deadline: Optional[float] = None,
               model: str = "",
-              fingerprint: Optional[int] = None) -> None:
+              fingerprint: Optional[int] = None,
+              tenant: str = "",
+              tenant_depth: int = 0) -> None:
         """Raise :class:`RequestShed` if the request should not enter the
         queue; record the admission otherwise. ``deadline`` is an absolute
         ``time.monotonic()`` instant (None = no SLO); ``model`` scopes
-        the p99 estimate to the tenant being requested; ``fingerprint``
-        is the payload's quarantine key (None = not fingerprintable)."""
+        the p99 estimate to the model being requested; ``fingerprint``
+        is the payload's quarantine key (None = not fingerprintable);
+        ``tenant_depth`` is the requesting tenant's current queue
+        occupancy, judged against its ``XGBTPU_TENANT_QUOTA``."""
         if self.draining:
             self._shed.labels(reason=DRAINING).inc()
             raise RequestShed(DRAINING, "server is draining (SIGTERM)")
+        quota = self.quotas.get(tenant, self.quotas.get("*"))
+        if quota is not None and tenant_depth >= quota:
+            self._shed.labels(reason=TENANT_QUOTA).inc()
+            raise RequestShed(
+                TENANT_QUOTA,
+                f"tenant {tenant or 'default'!r} has {tenant_depth} "
+                f"queued >= quota {quota}")
         if self.faults.quarantine.quarantined(fingerprint):
             self._shed.labels(reason=QUARANTINE).inc()
             raise RequestShed(

@@ -25,6 +25,16 @@ Knobs (env, read at construction):
   :class:`~xgboost_tpu.serving.faults.RequestError` and respawns the
   worker (crash-only: the queue and every waiting caller survive).
 
+Multi-tenant fairness (ISSUE 11): the queue is a
+:class:`~xgboost_tpu.serving.tenancy.TenantFairQueue` — per-tenant lanes
+dequeued in weighted-fair order (``XGBTPU_TENANT_WEIGHTS``, default
+equal; service cost = rows), so a hot tenant's backlog cannot starve a
+light tenant's dispatch share, and each tenant's queue occupancy is
+bounded at admission by ``XGBTPU_TENANT_QUOTA`` (shed reason
+``tenant_quota``). Requests from different tenants for the same model
+still coalesce into one dispatch — fairness decides *order*, not
+batching.
+
 Correctness invariants: rows are walked per-row-independently on every
 route (XLA program, pallas, native walker), so a coalesced result is
 bit-identical to the same request served alone; requests that cannot
@@ -58,11 +68,13 @@ from ..resilience import chaos, policy
 from . import faults
 from .admission import AdmissionController, RequestShed
 from .obs import RequestRecord, ServingRecorder
-from .tenancy import ModelEntry
+from .tenancy import (
+    OVERFLOW_TENANT, QUEUE_STOP, ModelEntry, TenantFairQueue,
+)
 
 __all__ = ["MicroBatcher"]
 
-_STOP = object()
+_STOP = QUEUE_STOP
 
 
 def _env_int(name: str, default: int) -> int:
@@ -82,13 +94,13 @@ def _env_float(name: str, default: float) -> float:
 class _Request:
     __slots__ = ("entry", "X", "n", "group_key", "predict_type",
                  "iteration_range", "missing", "base_margin", "deadline",
-                 "future", "rec", "fp")
+                 "future", "rec", "fp", "tenant")
 
     def __init__(self, entry: ModelEntry, X, n: int, group_key: Tuple,
                  predict_type: str, iteration_range, missing, base_margin,
                  deadline: Optional[float],
                  rec: Optional[RequestRecord],
-                 fp: Optional[int] = None) -> None:
+                 fp: Optional[int] = None, tenant: str = "") -> None:
         self.entry = entry
         self.X = X
         self.n = n
@@ -100,6 +112,7 @@ class _Request:
         self.deadline = deadline
         self.rec = rec
         self.fp = fp
+        self.tenant = tenant
         self.future: "Future" = Future()
         if rec is not None:
             # the response side of request tracing: every future carries
@@ -117,7 +130,8 @@ class MicroBatcher:
     def __init__(self, admission: Optional[AdmissionController] = None,
                  *, obs: Optional[ServingRecorder] = None,
                  max_wait_us: Optional[int] = None,
-                 max_batch_rows: Optional[int] = None) -> None:
+                 max_batch_rows: Optional[int] = None,
+                 tenant_weights=None) -> None:
         self.admission = admission or AdmissionController()
         self.obs = obs
         if max_wait_us is None:
@@ -130,7 +144,21 @@ class MicroBatcher:
             1, _env_int("XGBTPU_MAX_REQUEST_ROWS", 65536))
         self.watchdog_s = max(0.0, _env_float("XGBTPU_BATCHER_WATCHDOG",
                                               60.0))
-        self._q: "queue.Queue" = queue.Queue()
+        self._q = TenantFairQueue(tenant_weights)
+        # wire-supplied tenant names must not grow per-tenant state
+        # (labelled metric children, ledger caches, fair-queue lanes)
+        # without bound: past XGBTPU_TENANT_MAX distinct tenants, new
+        # names share the OVERFLOW_TENANT lane/label
+        self._tenant_cap = max(1, _env_int("XGBTPU_TENANT_MAX", 64))
+        self._tenants_seen: set = set()
+        self._tenant_overflow = REGISTRY.counter(
+            "serving_tenant_overflow_total",
+            "Requests whose tenant was folded into the shared overflow "
+            "lane because the distinct-tenant cap was reached")
+        self._tenant_rows = REGISTRY.counter(
+            "serving_tenant_dequeued_rows_total",
+            "Rows dequeued from the batcher per request tenant — the "
+            "weighted-fair dispatch-share ledger")
         self._depth = REGISTRY.gauge(
             "serving_queue_depth", "Requests waiting in the batcher queue")
         self._dispatches = REGISTRY.counter(
@@ -171,19 +199,21 @@ class MicroBatcher:
                predict_type: str = "value", iteration_range=None,
                missing: float = np.nan, base_margin=None,
                deadline: Optional[float] = None,
-               rec: Optional[RequestRecord] = None) -> "Future":
+               rec: Optional[RequestRecord] = None,
+               tenant: str = "") -> "Future":
         """Enqueue one predict request against a pinned model entry.
         Returns a Future resolving to the prediction array (rows in input
         order), or raising :class:`~xgboost_tpu.serving.RequestShed` /
         a typed dispatch error. ``deadline`` is absolute
         ``time.monotonic()``; ``rec`` is the server's request-trace
         record — sealed here on a shed/refusal, by the dispatch path
-        otherwise."""
+        otherwise; ``tenant`` picks the fair-queue lane (and quota) the
+        request rides."""
         try:
             return self._submit(entry, data, predict_type=predict_type,
                                 iteration_range=iteration_range,
                                 missing=missing, base_margin=base_margin,
-                                deadline=deadline, rec=rec)
+                                deadline=deadline, rec=rec, tenant=tenant)
         except BaseException as e:
             if self.obs is not None and rec is not None:
                 if isinstance(e, RequestShed):
@@ -196,9 +226,27 @@ class MicroBatcher:
                 e.request_id = rec.id
             raise
 
+    def _intern_tenant(self, tenant: str) -> str:
+        """Clamp an untrusted tenant name: length-capped, and folded into
+        the shared overflow lane once XGBTPU_TENANT_MAX distinct tenants
+        exist — per-tenant state stays bounded no matter what the wire
+        sends (the tenant-field analog of PR 10's input validation)."""
+        if not tenant:
+            return ""
+        tenant = str(tenant)[:64]
+        with self._lock:
+            if tenant in self._tenants_seen:
+                return tenant
+            if len(self._tenants_seen) < self._tenant_cap:
+                self._tenants_seen.add(tenant)
+                return tenant
+        self._tenant_overflow.inc()
+        return OVERFLOW_TENANT
+
     def _submit(self, entry: ModelEntry, data, *, predict_type,
                 iteration_range, missing, base_margin, deadline,
-                rec: Optional[RequestRecord]) -> "Future":
+                rec: Optional[RequestRecord], tenant: str = "") -> "Future":
+        tenant = self._intern_tenant(tenant)
         if iteration_range is not None \
                 and tuple(iteration_range) == (0, 0):
             iteration_range = None
@@ -239,6 +287,7 @@ class MicroBatcher:
         fp = faults.fingerprint(X) if coalescible else None
         if rec is not None:
             rec.rows = int(n)
+            rec.tenant = tenant
         rkey = None if iteration_range is None else tuple(iteration_range)
         with self._lock:
             if self._closed:
@@ -246,7 +295,9 @@ class MicroBatcher:
             # qsize is exact under the lock only for submitters; the
             # worker draining concurrently just makes admission lenient
             self.admission.admit(self._q.qsize(), deadline,
-                                 model=entry.label, fingerprint=fp)
+                                 model=entry.label, fingerprint=fp,
+                                 tenant=tenant,
+                                 tenant_depth=self._q.depth(tenant))
             req = _Request(
                 entry, X, n,
                 # sparse / base-margin requests get an identity key: they
@@ -254,23 +305,29 @@ class MicroBatcher:
                 (id(entry), predict_type, rkey, X.shape[1])
                 if coalescible else (object(),),
                 predict_type, iteration_range, missing, base_margin,
-                deadline, rec, fp)
+                deadline, rec, fp, tenant)
             entry.acquire()
-            self._q.put(req)
+            self._q.put(req, tenant=tenant, cost=float(n))
             self._depth.set(self._q.qsize())
         return req.future
 
     # ------------------------------------------------------------------
+    def _note_dequeue(self, req: "_Request") -> None:
+        if req.rec is not None:
+            req.rec.mark_dequeued()
+        if req.tenant:
+            self._tenant_rows.labels(tenant=req.tenant).inc(req.n)
+
     def _loop(self, gen: int) -> None:
         while True:
             with self._lock:
-                if self._gen != gen or self._closed and self._q.empty():
+                if self._gen != gen \
+                        or self._closed and self._q.qsize() == 0:
                     return
             item = self._q.get()
             if item is _STOP:
                 break
-            if item.rec is not None:
-                item.rec.mark_dequeued()
+            self._note_dequeue(item)
             batch = [item]
             rows = item.n
             window_end = time.monotonic() + self.max_wait_s
@@ -282,10 +339,8 @@ class MicroBatcher:
                 except queue.Empty:
                     break
                 if nxt is _STOP:
-                    self._q.put(_STOP)  # re-arm: exit after this batch
-                    break
-                if nxt.rec is not None:
-                    nxt.rec.mark_dequeued()
+                    break  # the stop flag is sticky: exit after this batch
+                self._note_dequeue(nxt)
                 batch.append(nxt)
                 rows += nxt.n
             self._depth.set(self._q.qsize())
@@ -515,7 +570,7 @@ class MicroBatcher:
                 return
             self._closed = True
             worker = self._worker
-            self._q.put(_STOP)
+            self._q.stop()  # sticky: get() drains the backlog, then STOP
         worker.join(timeout=max(0.1, deadline_s))
         leftovers = []
         while True:
@@ -523,8 +578,9 @@ class MicroBatcher:
                 item = self._q.get_nowait()
             except queue.Empty:
                 break
-            if item is not _STOP:
-                leftovers.append(item)
+            if item is _STOP:
+                break
+            leftovers.append(item)
         for req in leftovers:
             if not self._claim(req):
                 self._abandon(req)

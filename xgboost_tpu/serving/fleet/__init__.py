@@ -1,0 +1,34 @@
+"""Fleet serving tier: replicated crash-only servers behind a routing
+front (ISSUE 11 — the serving-side mirror of PAPER.md layer 8, the
+reference's distributed-frontends tier above rabit).
+
+Three pieces compose the single-process server (``serving/server.py``)
+into an N-replica fleet:
+
+- :mod:`~xgboost_tpu.serving.fleet.hashring` — deterministic consistent
+  hashing (md5 points, virtual nodes): model -> replica, minimally
+  disruptive under replica churn;
+- :mod:`~xgboost_tpu.serving.fleet.router` — the JSONL routing front on
+  one TCP port: consistent-hash placement with least-loaded spill,
+  replica health probing (``fleet_replica_healthy{replica=}``), typed
+  single-retry re-route on replica loss
+  (``resilience.policy.should_reroute``), broadcast ``load``/``swap``;
+- :mod:`~xgboost_tpu.serving.fleet.supervisor` — replica lifecycle:
+  spawn N ``serve`` children sharing ONE versioned manifest, respawn
+  any unplanned exit (the child restores from the manifest alone),
+  scale up/down via spawn + SIGTERM drain; ``python -m xgboost_tpu
+  serve-fleet`` wires supervisor + router into one command.
+
+The third fleet ingredient — real multi-tenant fairness under
+contention — lives in the core serving path where every replica applies
+it: :class:`~xgboost_tpu.serving.tenancy.TenantFairQueue` (weighted-fair
+dequeue) and the ``tenant_quota`` admission shed (``admission.py``).
+docs/serving.md "Scaling out" is the operator walkthrough.
+"""
+
+from .hashring import HashRing  # noqa: F401
+from .router import ReplicaEndpoint, Router  # noqa: F401
+from .supervisor import FleetSupervisor, serve_fleet_main  # noqa: F401
+
+__all__ = ["FleetSupervisor", "HashRing", "ReplicaEndpoint", "Router",
+           "serve_fleet_main"]

@@ -103,13 +103,14 @@ class RequestRecord:
     __slots__ = ("id", "model", "rows", "deadline_ms", "unix_ms",
                  "t_submit", "t_dequeue", "t_dispatch0", "t_dispatch1",
                  "t_done", "route", "bucket", "coalesced", "outcome",
-                 "shed_reason", "error")
+                 "shed_reason", "error", "tenant")
 
     def __init__(self, request_id: Optional[str],
                  deadline_ms: Optional[float]) -> None:
         self.id = str(request_id) if request_id is not None \
             else next_request_id()
         self.model = ""
+        self.tenant = ""
         self.rows = 0
         self.deadline_ms = deadline_ms
         self.unix_ms = time.time() * 1e3
@@ -153,6 +154,8 @@ class RequestRecord:
         for k, v in (stages if stages is not None
                      else self.stage_seconds()).items():
             doc[k] = round(v, 9)
+        if self.tenant:
+            doc["tenant"] = self.tenant
         if self.route:
             doc["route"] = self.route
         if self.deadline_ms is not None:
@@ -213,12 +216,15 @@ class SLOLedger:
                             for o in ("ok", "shed", "error", "abandoned")}
         self._burn.set(0.0)
 
-    def _model_child(self, stage: str, model: str):
-        key = (stage, model)
+    def _child(self, stage: str, **labels):
+        """Cached labelled child (per-model / per-tenant) — ``labels()``
+        pays a sort + family lock per call and observe() runs per
+        request."""
+        key = (stage, tuple(sorted(labels.items())))
         child = self._per_model.get(key)
         if child is None:
             child = self._per_model[key] = \
-                self._hists[stage].labels(model=model)
+                self._hists[stage].labels(**labels)
         return child
 
     # ------------------------------------------------------------------
@@ -235,7 +241,12 @@ class SLOLedger:
                 continue
             self._unlabelled[stage].observe(v)
             if rec.model:
-                self._model_child(stage, rec.model).observe(v)
+                self._child(stage, model=rec.model).observe(v)
+            if rec.tenant:
+                # per-tenant SLO children (ISSUE 11): a hot tenant's tail
+                # must be visible separately from the light tenant it
+                # could be starving
+                self._child(stage, tenant=rec.tenant).observe(v)
         self._by_outcome.get(rec.outcome, self._by_outcome["error"]).inc()
         if rec.deadline_ms is not None:
             missed = rec.outcome != "ok" \
@@ -275,12 +286,18 @@ class SLOLedger:
         and per model), deadline accounting, current burn."""
         stages: Dict[str, Any] = {}
         per_model: Dict[str, Dict[str, float]] = {}
+        per_tenant: Dict[str, Dict[str, float]] = {}
         for stage in _STAGES:
             for labels, qs in REGISTRY.quantiles(
                     f"serving_{stage}_seconds"):
                 model = labels.get("model")
+                tenant = labels.get("tenant")
                 if model:
                     per_model.setdefault(model, {}).update(
+                        {f"{stage}_{k}_s": round(v, 9)
+                         for k, v in qs.items() if v is not None})
+                elif tenant:
+                    per_tenant.setdefault(tenant, {}).update(
                         {f"{stage}_{k}_s": round(v, 9)
                          for k, v in qs.items() if v is not None})
                 elif not labels:
@@ -296,6 +313,7 @@ class SLOLedger:
             },
             "stages": stages,
             "per_model": per_model,
+            "per_tenant": per_tenant,
             "exemplars": self.exemplars(),
         }
 

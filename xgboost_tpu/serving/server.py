@@ -65,23 +65,30 @@ class ModelServer:
                  max_queue: Optional[int] = None,
                  batch_wait_us: Optional[int] = None,
                  max_batch_rows: Optional[int] = None,
-                 run_dir: Optional[str] = None) -> None:
+                 run_dir: Optional[str] = None,
+                 manifest_path: Optional[str] = None,
+                 tenant_weights=None) -> None:
         self.obs = ServingRecorder(run_dir)
         # the crash-only contract root: the resident-model manifest (and
         # raw-source spill files) live directly under the run_dir, next
-        # to (not inside) the obs/ tree
+        # to (not inside) the obs/ tree — unless ``manifest_path`` points
+        # elsewhere (the fleet tier: N replicas share ONE manifest while
+        # keeping private run_dirs, serving/fleet/supervisor.py)
         self._run_root = run_dir or os.environ.get("XGBTPU_SERVE_DIR")
+        self._manifest_path = manifest_path or (
+            os.path.join(self._run_root, "manifest.json")
+            if self._run_root else None)
         self.faults = FaultDomain(on_event=self.obs.event)
         self.registry = ModelRegistry(arena_mb, on_event=self._on_event)
         self.admission = AdmissionController(max_queue, faults=self.faults)
         self.batcher = MicroBatcher(
             self.admission, obs=self.obs, max_wait_us=batch_wait_us,
-            max_batch_rows=max_batch_rows)
+            max_batch_rows=max_batch_rows, tenant_weights=tenant_weights)
         self._swapper = SwapRunner(self.registry, on_event=self._on_event)
         self._closed = False
         self._draining = False
         self._manifest_lock = threading.Lock()
-        if self._run_root:
+        if self._manifest_path:
             self._restore_manifest()
         if models:
             for name, source in models.items():
@@ -127,46 +134,96 @@ class ModelServer:
     # crash-only restart: the resident-model manifest
     # ------------------------------------------------------------------
     def _write_manifest(self) -> None:
-        """Atomically persist name@version -> retained source under the
-        run_dir. ``raw`` sources (live Boosters) are spilled to
-        ``run_dir/models/<name>@v<N>.json`` once so they survive the
-        process; path-shaped sources are recorded as-is."""
-        if not self._run_root:
+        """Atomically persist name@version -> retained source next to the
+        manifest. ``raw`` sources (live Boosters) are spilled to
+        ``<manifest dir>/models/<name>@v<N>.json`` once so they survive
+        the process; path-shaped sources are recorded as-is.
+
+        Fleet contract (ISSUE 11): N replicas may share ONE manifest.
+        Every write is (a) **atomic** — ``flight.atomic_write_json``'s
+        pid-unique tmp + rename, so two replicas racing never produce a
+        torn file; (b) a **read-merge-write** — versions recorded on disk
+        by other replicas are kept (only this server's view of a (name,
+        version) it also holds, and its live pointers, win); (c) stamped
+        with a **last-writer-wins ``version`` field** (disk version + 1)
+        so readers can observe write ordering. The read-merge-write
+        window is serialized across processes with a best-effort advisory
+        ``flock`` (held for the milliseconds of one merge; a filesystem
+        without lock support degrades to lock-free last-writer-wins,
+        where a racing writer's very latest registration can be shadowed
+        until its next write — readers never see a torn or unparseable
+        file either way)."""
+        if not self._manifest_path:
             return
         with self._manifest_lock:
-            models: Dict[str, Any] = {}
-            live = self.registry.models()
-            for (name, v), (kind, payload) in sorted(
-                    self.registry.sources_snapshot().items()):
-                if kind == "raw":
-                    mdir = os.path.join(self._run_root, "models")
-                    path = os.path.join(mdir, f"{name}@v{v}.json")
+            lockf = None
+            try:
+                import fcntl
+
+                lockf = open(f"{self._manifest_path}.lock", "w")
+                fcntl.flock(lockf, fcntl.LOCK_EX)
+            except (ImportError, OSError):
+                lockf = None  # degrade: atomic rename + LWW version
+            try:
+                self._write_manifest_merged()
+            finally:
+                if lockf is not None:
                     try:
-                        if not os.path.exists(path):
-                            os.makedirs(mdir, exist_ok=True)
-                            tmp = f"{path}.tmp.{os.getpid()}"
-                            with open(tmp, "wb") as f:
-                                f.write(bytes(payload))
-                                f.flush()
-                                os.fsync(f.fileno())
-                            os.replace(tmp, path)
+                        lockf.close()  # releases the flock
                     except OSError:
-                        continue  # unspillable source: not restartable
-                    kind, payload = "file", path
-                doc = models.setdefault(
-                    name, {"live": live.get(name), "versions": {}})
-                doc["versions"][str(v)] = {"kind": kind, "path": payload}
-            _flight.atomic_write_json(
-                os.path.join(self._run_root, "manifest.json"),
-                {"format": MANIFEST_FORMAT, "pid": os.getpid(),
-                 "unix_ms": time.time() * 1e3, "models": models})
+                        pass
+
+    def _write_manifest_merged(self) -> None:
+        """The read-merge-write body of :meth:`_write_manifest` (runs
+        under the process lock, and the cross-process flock when
+        available)."""
+        root = os.path.dirname(self._manifest_path) or "."
+        try:
+            with open(self._manifest_path) as f:
+                prev = json.load(f)
+            if prev.get("format") != MANIFEST_FORMAT:
+                prev = {}
+        except (OSError, ValueError):
+            prev = {}
+        models: Dict[str, Any] = {
+            name: {"live": info.get("live"),
+                   "versions": dict(info.get("versions", {}))}
+            for name, info in (prev.get("models") or {}).items()
+            if isinstance(info, dict)}
+        live = self.registry.models()
+        for (name, v), (kind, payload) in sorted(
+                self.registry.sources_snapshot().items()):
+            if kind == "raw":
+                mdir = os.path.join(root, "models")
+                path = os.path.join(mdir, f"{name}@v{v}.json")
+                try:
+                    if not os.path.exists(path):
+                        os.makedirs(mdir, exist_ok=True)
+                        tmp = f"{path}.tmp.{os.getpid()}"
+                        with open(tmp, "wb") as f:
+                            f.write(bytes(payload))
+                            f.flush()
+                            os.fsync(f.fileno())
+                        os.replace(tmp, path)
+                except OSError:
+                    continue  # unspillable source: not restartable
+                kind, payload = "file", path
+            doc = models.setdefault(name, {"live": None, "versions": {}})
+            if name in live:
+                doc["live"] = live[name]
+            doc["versions"][str(v)] = {"kind": kind, "path": payload}
+        _flight.atomic_write_json(
+            self._manifest_path,
+            {"format": MANIFEST_FORMAT, "pid": os.getpid(),
+             "version": int(prev.get("version", 0) or 0) + 1,
+             "unix_ms": time.time() * 1e3, "models": models})
 
     def _restore_manifest(self) -> None:
         """Crash-only restart: re-register every manifest source LAZILY
         (no booster builds, no compiles) — the first request per model
         faults it in exactly like an LRU eviction would
         (docs/serving.md "Failure handling")."""
-        path = os.path.join(self._run_root, "manifest.json")
+        path = self._manifest_path
         try:
             with open(path) as f:
                 doc = json.load(f)
@@ -212,7 +269,8 @@ class ModelServer:
                       version: Optional[int] = None,
                       predict_type: str = "value", iteration_range=None,
                       missing: float = np.nan, base_margin=None,
-                      request_id: Optional[str] = None) -> "Future":
+                      request_id: Optional[str] = None,
+                      tenant: str = "") -> "Future":
         """Admit + enqueue one request; the Future resolves to the
         prediction (or raises :class:`RequestShed` / the dispatch error)
         and carries ``.request_id`` — the caller-supplied id or a
@@ -223,6 +281,7 @@ class ModelServer:
         if self._closed:
             raise RuntimeError("model server is closed")
         rec = self.obs.start_request(request_id, deadline_ms)
+        rec.tenant = tenant
         deadline = (time.monotonic() + deadline_ms / 1e3
                     if deadline_ms is not None else None)
         try:
@@ -237,7 +296,8 @@ class ModelServer:
         return self.batcher.submit(
             entry, data, predict_type=predict_type,
             iteration_range=iteration_range, missing=missing,
-            base_margin=base_margin, deadline=deadline, rec=rec)
+            base_margin=base_margin, deadline=deadline, rec=rec,
+            tenant=tenant)
 
     def predict(self, name: str, data, *,
                 timeout: Optional[float] = 60.0, **kw) -> np.ndarray:
@@ -303,6 +363,7 @@ def _handle(server: ModelServer, msg: Dict[str, Any],
                 msg.get("model", "default"), data,
                 deadline_ms=msg.get("deadline_ms"),
                 request_id=None if rid is None else str(rid),
+                tenant=str(msg.get("tenant", "") or ""),
                 predict_type=("margin" if msg.get("margin")
                               else "value"),
                 iteration_range=(tuple(msg["iteration_range"])
@@ -323,6 +384,13 @@ def _handle(server: ModelServer, msg: Dict[str, Any],
             out["metrics"] = server.metrics()
         elif op == "stats":
             out["stats"] = server.stats()
+        elif op == "ping":
+            # the fleet router's health probe: one cheap line, no drain
+            # barrier (serving/fleet/router.py)
+            out["ok"] = True
+            out["draining"] = server.draining
+            out["queue_depth"] = server.batcher.queue_depth()
+            out["pid"] = os.getpid()
         elif op == "shutdown":
             out["ok"] = True
             shutdown()
@@ -346,7 +414,8 @@ def _parse_serve_args(argv: List[str]) -> Dict[str, Any]:
     flags = {"--port": ("port", int), "--arena-mb": ("arena_mb", float),
              "--batch-wait-us": ("batch_wait_us", int),
              "--max-queue": ("max_queue", int), "--host": ("host", str),
-             "--run-dir": ("run_dir", str)}
+             "--run-dir": ("run_dir", str),
+             "--manifest": ("manifest_path", str)}
     i = 0
     while i < len(argv):
         a = argv[i]
@@ -382,7 +451,8 @@ def serve_main(argv: List[str], stdin=None, stdout=None) -> int:
         print(f"serve: {e}", file=sys.stderr)
         print("usage: python -m xgboost_tpu serve (--port N | --stdin) "
               "[--model name=path ...] [--arena-mb M] [--batch-wait-us U] "
-              "[--max-queue Q] [--host H] [--run-dir D]", file=sys.stderr)
+              "[--max-queue Q] [--host H] [--run-dir D] [--manifest F]",
+              file=sys.stderr)
         return 1
     stdin = stdin if stdin is not None else sys.stdin
     stdout = stdout if stdout is not None else sys.stdout
@@ -390,7 +460,8 @@ def serve_main(argv: List[str], stdin=None, stdout=None) -> int:
         opts["models"], arena_mb=opts.get("arena_mb"),
         max_queue=opts.get("max_queue"),
         batch_wait_us=opts.get("batch_wait_us"),
-        run_dir=opts.get("run_dir"))
+        run_dir=opts.get("run_dir"),
+        manifest_path=opts.get("manifest_path"))
 
     def respond(obj: Dict[str, Any], fh) -> None:
         fh.write(json.dumps(obj) + "\n")

@@ -1,4 +1,5 @@
-"""Multi-model tenancy: a forest-snapshot arena with an LRU memory budget.
+"""Multi-model tenancy: a forest-snapshot arena with an LRU memory budget,
+plus the request-side half — weighted-fair queuing across request tenants.
 
 One serving process hosts many models (the reference serves this from its
 bindings tier — one ``Booster`` handle per model, the host application
@@ -17,24 +18,54 @@ doing its own bookkeeping). Here the bookkeeping is first-class:
   ``hits + misses == get() calls`` is a pinned invariant
   (tests/test_model_server.py).
 
+The second kind of tenant is the *caller*: one serving process fronts
+many request tenants, and under contention a hot tenant flooding the
+micro-batcher queue must not starve the others (ISSUE 11). That half
+lives here too:
+
+- :class:`TenantFairQueue` — the micro-batcher's request queue, replaced
+  from plain FIFO: per-tenant lanes dequeued by start-time fair queuing
+  (virtual time advances by ``rows / weight`` per dequeue, weights from
+  ``XGBTPU_TENANT_WEIGHTS``, default equal). While a light tenant has
+  anything queued it receives its weight share of dequeued rows no matter
+  how deep the hot tenant's backlog is — the fairness pin in
+  tests/test_fleet.py.
+- :func:`tenant_weights` / :func:`tenant_quota` — the env grammars
+  (``name=N,*=M``, same shape as ``XGBTPU_RETRY``). Quotas bound each
+  tenant's *queue occupancy* at admission (``admission.py`` sheds with
+  reason ``tenant_quota``), so one tenant can never fill the bounded
+  queue to the point where another's traffic sheds ``queue_full``.
+
 Registry metrics: ``serving_arena_bytes`` / ``serving_models_resident``
 gauges, ``serving_model_loads_total{model=}``,
 ``serving_model_evictions_total``, ``serving_model_hits_total`` /
-``serving_model_misses_total``.
+``serving_model_misses_total``; per-tenant
+``serving_tenant_dequeued_rows_total{tenant=}``.
 """
 
 from __future__ import annotations
 
 import os
 import threading
-from collections import OrderedDict
+from collections import OrderedDict, deque
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from ..observability.metrics import REGISTRY
 
-__all__ = ["ModelEntry", "ModelRegistry", "resolve_source", "load_booster"]
+__all__ = ["ModelEntry", "ModelRegistry", "resolve_source", "load_booster",
+           "TenantFairQueue", "tenant_weights", "tenant_quotas",
+           "tenant_quota", "QUEUE_STOP", "OVERFLOW_TENANT"]
+
+_ENV_WEIGHTS = "XGBTPU_TENANT_WEIGHTS"
+_ENV_QUOTA = "XGBTPU_TENANT_QUOTA"
+_ENV_TENANT_MAX = "XGBTPU_TENANT_MAX"
+
+#: the shared lane/label every tenant past the distinct-tenant cap maps
+#: to — wire-supplied tenant names must not grow per-tenant server state
+#: (metric children, ledger caches, fair-queue lanes) without bound
+OVERFLOW_TENANT = "overflow"
 
 
 def _env_float(name: str, default: float) -> float:
@@ -42,6 +73,156 @@ def _env_float(name: str, default: float) -> float:
         return float(os.environ.get(name, str(default)))
     except ValueError:
         return default
+
+
+# ---------------------------------------------------------------------------
+# request tenants: weights, quotas, the weighted-fair queue
+# ---------------------------------------------------------------------------
+
+
+def _parse_tenant_map(raw: Optional[str], conv) -> Dict[str, Any]:
+    """``name=N,*=M`` (or a bare number meaning ``*=N``) -> dict. The
+    shared grammar of ``XGBTPU_TENANT_WEIGHTS`` / ``XGBTPU_TENANT_QUOTA``
+    (mirrors ``XGBTPU_RETRY``); malformed parts are skipped — a bad env
+    must never take the server down."""
+    out: Dict[str, Any] = {}
+    if not raw:
+        return out
+    for part in raw.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" in part:
+            k, _, v = part.partition("=")
+            k, v = k.strip(), v.strip()
+        else:
+            k, v = "*", part
+        try:
+            out[k] = conv(v)
+        except ValueError:
+            continue
+    return out
+
+
+def tenant_weights(env: Optional[str] = None) -> Dict[str, float]:
+    """Per-tenant scheduling weights (``XGBTPU_TENANT_WEIGHTS``). Missing
+    tenants take the ``*`` entry, default 1.0 — equal shares."""
+    raw = env if env is not None else os.environ.get(_ENV_WEIGHTS)
+    return {k: max(v, 1e-6)
+            for k, v in _parse_tenant_map(raw, float).items() if v > 0}
+
+
+def tenant_quotas(env: Optional[str] = None) -> Dict[str, int]:
+    """The parsed ``XGBTPU_TENANT_QUOTA`` table — parsed ONCE at
+    controller construction (the admit path runs per request; same
+    read-at-construction contract as every other serving knob)."""
+    raw = env if env is not None else os.environ.get(_ENV_QUOTA)
+    return {k: max(1, int(v))
+            for k, v in _parse_tenant_map(raw, int).items()}
+
+
+def tenant_quota(tenant: str, env: Optional[str] = None) -> Optional[int]:
+    """Max queued requests for ``tenant`` (``XGBTPU_TENANT_QUOTA``), or
+    None = unbounded (only the global queue bound applies)."""
+    table = tenant_quotas(env)
+    return table.get(tenant, table.get("*"))
+
+
+#: returned by :meth:`TenantFairQueue.get` once the queue is stopped AND
+#: drained — the batcher worker's exit marker (never before the last
+#: queued request, so ``close(drain=True)`` keeps serving the backlog)
+QUEUE_STOP = object()
+
+
+class TenantFairQueue:
+    """Weighted-fair request queue: per-tenant FIFO lanes, dequeued in
+    start-time-fair-queuing order.
+
+    Every item enqueues with a *virtual finish tag*
+    ``max(vtime, tenant's last tag) + cost / weight`` (cost = request
+    rows: the resource a dispatch actually spends); :meth:`get` always
+    returns the item with the smallest head tag, and advances the queue's
+    virtual time to it. Consequences, both pinned by tests:
+
+    - a backlogged tenant's lane drains at its weight share of dequeued
+      rows, independent of how many requests it stuffed into the queue;
+    - a tenant with a shallow lane (the "light" tenant under a hot-tenant
+      flood) enqueues near the current virtual time and is dequeued
+      within ~one weighted round, so its queue wait is bounded by the
+      *active tenant count*, not the hot tenant's backlog.
+
+    FIFO order inside a lane is preserved (tags are monotonic per
+    tenant). With a single tenant this degrades to the plain FIFO queue
+    it replaced. Thread-safe; ``maxsize`` is advisory only (admission
+    owns the bound)."""
+
+    def __init__(self, weights: Optional[Dict[str, float]] = None) -> None:
+        self._cv = threading.Condition()
+        self._lanes: "Dict[str, deque]" = {}  # tenant -> deque[(tag, item)]
+        self._weights = tenant_weights() if weights is None \
+            else {k: max(float(v), 1e-6) for k, v in weights.items()}
+        self._last_tag: Dict[str, float] = {}
+        self._vtime = 0.0
+        self._size = 0
+        self._stopped = False
+
+    def weight(self, tenant: str) -> float:
+        return self._weights.get(tenant, self._weights.get("*", 1.0))
+
+    # ------------------------------------------------------------------
+    def put(self, item: Any, tenant: str = "", cost: float = 1.0) -> None:
+        with self._cv:
+            if self._stopped:
+                raise RuntimeError("queue is stopped")
+            tag = max(self._vtime, self._last_tag.get(tenant, 0.0)) \
+                + max(cost, 1e-9) / self.weight(tenant)
+            self._last_tag[tenant] = tag
+            self._lanes.setdefault(tenant, deque()).append((tag, item))
+            self._size += 1
+            self._cv.notify()
+
+    def get(self, timeout: Optional[float] = None) -> Any:
+        """Next item in weighted-fair order. Blocks up to ``timeout``
+        (None = forever); raises ``queue.Empty`` on timeout, returns
+        :data:`QUEUE_STOP` once stopped and drained."""
+        import queue as _queue
+
+        with self._cv:
+            if not self._cv.wait_for(
+                    lambda: self._size > 0 or self._stopped, timeout):
+                raise _queue.Empty
+            if self._size == 0:
+                return QUEUE_STOP
+            tenant = min(self._lanes, key=lambda t: self._lanes[t][0][0])
+            tag, item = self._lanes[tenant].popleft()
+            if not self._lanes[tenant]:
+                del self._lanes[tenant]
+            self._vtime = max(self._vtime, tag)
+            self._size -= 1
+            return item
+
+    def get_nowait(self) -> Any:
+        return self.get(timeout=0)
+
+    def stop(self) -> None:
+        """No further :meth:`put`; :meth:`get` serves the backlog then
+        returns :data:`QUEUE_STOP` (the positional-sentinel analog for a
+        queue whose order is no longer FIFO)."""
+        with self._cv:
+            self._stopped = True
+            self._cv.notify_all()
+
+    # ------------------------------------------------------------------
+    def qsize(self) -> int:
+        with self._cv:
+            return self._size
+
+    def depth(self, tenant: str) -> int:
+        """Queued requests for one tenant — the admission layer's quota
+        input."""
+        with self._cv:
+            lane = self._lanes.get(tenant)
+            return len(lane) if lane else 0
 
 
 # ---------------------------------------------------------------------------
