@@ -687,6 +687,171 @@ print(f"fleet serve-report OK: {len(merged)} merged events, "
       f"{len(rep['replicas'])} replicas, tenants {sorted(rep['tenants'])}")
 EOF
 
+echo "=== tier 1.9: delivery lane (train -> canary -> promote -> rollback) ==="
+# Continuous train-to-serve delivery end to end (ISSUE 12): a
+# checkpointed train feeds a live server through the delivery
+# controller — publish -> fractional canary under concurrent traffic ->
+# SLO+AUC gates -> warm promote; then a regression is injected on
+# EXACTLY the promoted version (XGBTPU_CHAOS_MODEL), the name-keyed
+# breaker trips and the controller auto-rolls back to last-good and
+# quarantines the bad version in the manifest. A corrupted checkpoint
+# must be skipped (counted; old version keeps serving) and a fresh
+# watcher must never re-promote the quarantined round. Zero requests
+# may go unanswered at any point; the delivery metrics must appear in
+# the exposition and the delivery timeline in serve-report.
+DELIV_DIR=$(mktemp -d /tmp/xgbtpu_ci_delivery.XXXXXX)
+export DELIV_DIR
+python - <<'EOF'
+import os, threading, time
+
+os.environ.pop("XGBTPU_TRACE", None)
+os.environ.pop("XGBTPU_CHAOS", None)
+os.environ["XGBTPU_BREAKER_MIN"] = "4"
+os.environ["XGBTPU_BREAKER_WINDOW"] = "8"
+
+import numpy as np
+
+import xgboost_tpu as xgb
+from xgboost_tpu.observability import REGISTRY
+from xgboost_tpu.resilience import checkpoint as ckpt
+from xgboost_tpu.serving import (
+    DeliveryController, ModelServer, RequestError, RequestShed,
+)
+
+tmp = os.environ["DELIV_DIR"]
+watch = os.path.join(tmp, "ckpts")
+rng = np.random.RandomState(0)
+X = rng.randn(400, 5).astype(np.float32)
+y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
+params = {"objective": "binary:logistic", "max_depth": 3, "max_bin": 16,
+          "verbosity": 0, "seed": 3}
+
+def counter(name, **labels):
+    fam = REGISTRY.get(name)
+    return 0.0 if fam is None else fam.labels(**labels).value
+
+def wait(pred, timeout=120, period=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(period)
+    return pred()
+
+# 1. checkpointed train seeds the serving plane (from the verified
+# PAYLOAD, not the live checkpoint path — training retention owns and
+# prunes those files; the manifest spills bytes durably)
+xgb.train(params, xgb.DMatrix(X, label=y), 3, resume_from=watch,
+          verbose_eval=False)
+seed = ckpt.read_checkpoint(ckpt.checkpoint_path(watch, 3))
+assert seed is not None
+srv = ModelServer({"m": bytes(seed[0])},
+                  run_dir=os.path.join(tmp, "srv"), batch_wait_us=0)
+assert srv.registry.live_version("m") == 1
+ctl = srv.deliver("m", watch, mode="fraction", fraction=0.5,
+                  min_requests=6, poll_s=0.05, bake_s=30.0,
+                  eval_data=(X[:200], y[:200]), canary_deadline_s=120,
+                  p99_ratio=8.0)  # loaded 1-core CI box: the p99 gate's
+                  # own behavior is pinned deterministically in
+                  # tests/test_delivery.py
+
+# 2. live traffic: EVERY request must resolve (ok or typed) — an
+# unanswered future is a dropped request and fails the lane
+stop = threading.Event()
+ok, typed, dropped = [], [], []
+def traffic():
+    i = 0
+    while not stop.is_set():
+        i += 1
+        off = (i * 7) % 300
+        try:
+            ok.append(srv.predict("m", X[off:off + 4], timeout=30,
+                                  request_id=f"c{i}"))
+        except TimeoutError as e:
+            dropped.append(repr(e))
+        except (RequestError, RequestShed) as e:
+            typed.append(e)
+        time.sleep(0.002)
+t = threading.Thread(target=traffic); t.start()
+
+# 3. continuous training appends rounds -> publish -> canary -> promote.
+# checkpoint_interval=2: exactly ONE new checkpoint (rounds 5) lands —
+# a fast watcher poll must not catch the intermediate rounds-4 snapshot
+# first and deliver it, which would shift every version number (and the
+# quarantined rounds) this lane asserts on
+xgb.train(params, xgb.DMatrix(X, label=y), 2, resume_from=watch,
+          resume_mode="append", checkpoint_interval=2,
+          verbose_eval=False)
+assert wait(lambda: srv.registry.live_version("m") == 2), \
+    f"promotion never landed: {ctl.status()}"
+print("delivery: promoted m@v2", flush=True)
+
+# 4. regression ships on EXACTLY the promoted version, mid-bake: the
+# breaker trips, the controller rolls back + quarantines
+os.environ["XGBTPU_CHAOS_MODEL"] = "m@v2"
+assert wait(lambda: ctl.status()["history"]), ctl.status()
+os.environ.pop("XGBTPU_CHAOS_MODEL")
+h = ctl.status()["history"][-1]
+assert h["outcome"] == "rolled_back", h
+assert srv.registry.live_version("m") == 1
+assert srv.quarantined_versions("m")[2]["rounds"] == 5
+print("delivery: rolled back to m@v1, v2 quarantined", flush=True)
+
+# 5. a corrupted checkpoint is skipped and counted; v1 keeps serving
+with open(ckpt.checkpoint_path(watch, 5), "rb") as f:
+    raw5 = f.read()
+ckpt.atomic_write_bytes(ckpt.checkpoint_path(watch, 7), raw5[:-20])
+s0 = counter("delivery_checkpoints_skipped_total", reason="corrupt")
+assert wait(lambda: counter("delivery_checkpoints_skipped_total",
+                            reason="corrupt") > s0)
+assert srv.registry.live_version("m") == 1
+stop.set(); t.join(30)
+assert not dropped, f"dropped requests: {dropped[:3]}"
+assert len(ok) > 20, "traffic never flowed"
+print(f"delivery: {len(ok)} ok, {len(typed)} typed failures/sheds, "
+      f"0 dropped", flush=True)
+srv.stop_delivery("m")
+srv.close()
+
+# 6. restart-survives: manifest carries live pointer + quarantine; a
+# fresh watcher skips the quarantined round forever
+srv2 = ModelServer(run_dir=os.path.join(tmp, "srv"), batch_wait_us=0)
+assert srv2.registry.live_version("m") == 1
+assert 2 in srv2.quarantined_versions("m")
+q0 = counter("delivery_checkpoints_skipped_total", reason="quarantined")
+# from_rounds=4: the scan's scope is the quarantined rounds-5 checkpoint
+# and the corrupt rounds-7 one — BOTH must be refused, nothing delivered
+ctl2 = DeliveryController(srv2, "m", watch, from_rounds=4,
+                          poll_s=0.05, bake_s=0.1)
+assert ctl2.poll() is None, "quarantined round must never re-promote"
+assert counter("delivery_checkpoints_skipped_total",
+               reason="quarantined") > q0
+assert srv2.registry.live_version("m") == 1
+out = srv2.predict("m", X[:4], timeout=30)
+assert out is not None
+srv2.close()
+
+# 7. the delivery metric surface is in the exposition
+expo = REGISTRY.exposition()
+for needle in ("delivery_promotions_total 1",
+               "delivery_rollbacks_total 1",
+               "delivery_quarantines_total 1",
+               'delivery_checkpoints_skipped_total{reason="corrupt"}',
+               'delivery_checkpoints_skipped_total{reason="quarantined"}',
+               'delivery_canary_requests_total{arm="candidate",model="m"}'):
+    assert needle in expo, f"missing from exposition: {needle}"
+print("delivery lane OK", flush=True)
+EOF
+python -m xgboost_tpu serve-report "$DELIV_DIR/srv" > /tmp/xgbtpu_delivery_report.txt
+grep -q "model delivery (train-to-serve loop):" /tmp/xgbtpu_delivery_report.txt
+for ev in checkpoint_seen model_published canary_start model_promoted \
+          model_rolled_back model_quarantined checkpoint_skipped; do
+  grep -q "$ev" /tmp/xgbtpu_delivery_report.txt || {
+    echo "serve-report missing delivery event: $ev"; exit 1; }
+done
+echo "delivery serve-report OK (timeline renders all delivery events)"
+rm -rf "$DELIV_DIR" /tmp/xgbtpu_delivery_report.txt
+
 echo "=== tier 2: trace parses as Chrome trace JSON ==="
 # load_trace raises on malformed output; trace-report exits nonzero
 python -m xgboost_tpu trace-report "$TRACE_OUT" > /dev/null

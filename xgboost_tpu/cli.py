@@ -7,11 +7,13 @@ key=value config parser (``src/common/config.h``). Usage:
     python -m xgboost_tpu trace-report <trace-file|glob> ... [--top N]
     python -m xgboost_tpu obs-report <run_dir> ... [--top-rounds N]
     python -m xgboost_tpu serve-report <run_dir> ... [--top N]
-    python -m xgboost_tpu checkpoint-inspect <dir>
+    python -m xgboost_tpu checkpoint-inspect <dir> [--json]
     python -m xgboost_tpu serve (--port N | --stdin) [--model name=path ...]
-        [--run-dir D] [--manifest F]
+        [--deliver name=watch_dir ...] [--run-dir D] [--manifest F]
     python -m xgboost_tpu serve-fleet --port N --run-dir D [--replicas K]
         [--model name=path ...]
+    python -m xgboost_tpu deliver --connect HOST:PORT --model M --watch DIR
+        [--mode shadow|fraction] [--eval-npz F] | --status | --stop
 
 Config keys mirror the reference: task, data, test:data, model_in,
 model_out, model_dir, num_round, save_period, eval[name]=path, dump_format,
@@ -117,6 +119,8 @@ def cli_main(argv: List[str]) -> int:
         return lint_main(argv[1:])
     if argv[0] == "checkpoint-inspect":
         return checkpoint_inspect_main(argv[1:])
+    if argv[0] == "deliver":
+        return deliver_main(argv[1:])
     if argv[0] == "serve":
         from .serving.server import serve_main
 
@@ -183,17 +187,39 @@ def cli_main(argv: List[str]) -> int:
 
 
 def checkpoint_inspect_main(argv: List[str]) -> int:
-    """``checkpoint-inspect <dir>``: the operator-facing read side of
-    ``resume_from`` — what is on disk, what verifies, what a resume
-    would actually load."""
+    """``checkpoint-inspect <dir> [--json]``: the operator-facing read
+    side of ``resume_from`` — what is on disk, what verifies, what a
+    resume would actually load. ``--json`` emits the machine-readable
+    form (one document: records + the newest-verified path) — the
+    delivery controller's poll primitive, scriptable for operators
+    (exit status semantics unchanged: 1 when nothing verifies)."""
+    import json
+
+    as_json = "--json" in argv
+    argv = [a for a in argv if a != "--json"]
     if not argv or argv[0].startswith("-"):
-        print("usage: python -m xgboost_tpu checkpoint-inspect <dir>",
-              file=sys.stderr)
+        print("usage: python -m xgboost_tpu checkpoint-inspect <dir> "
+              "[--json]", file=sys.stderr)
         return 1
     from .resilience.checkpoint import inspect_dir
 
     directory = argv[0]
     records = inspect_dir(directory)
+    if as_json:
+        newest = [r for r in records if r["newest_verified"]]
+        # multi-rank dirs mark one newest-verified PER resume scope (the
+        # top dir plus each rank<N>/); the top-level answer is the most
+        # advanced verified snapshot across all of them, not whichever
+        # scope happened to be listed last
+        best = max(newest, key=lambda r: r["rounds"]) if newest else None
+        print(json.dumps({
+            "dir": directory,
+            "records": records,
+            "newest_verified": best["path"] if best else None,
+            "newest_verified_rounds":
+                best["rounds"] if best else None,
+        }, indent=2))
+        return 0 if best else 1
     if not records:
         print(f"{directory}: no checkpoints found")
         return 1
@@ -209,6 +235,84 @@ def checkpoint_inspect_main(argv: List[str]) -> int:
     print("\n'*' = newest verified (what train(resume_from=...) / "
           "elastic replay loads)")
     return 0 if any_ok else 1
+
+
+def deliver_main(argv: List[str]) -> int:
+    """``deliver``: the operator client for the serving ``deliver`` op —
+    attach (or inspect/stop) a continuous train-to-serve delivery
+    controller on a RUNNING server or fleet router over the JSONL
+    protocol (docs/serving.md "Model delivery")::
+
+        python -m xgboost_tpu deliver --connect HOST:PORT \\
+            --model M --watch CKPT_DIR [--mode shadow|fraction]
+            [--fraction F] [--min-requests N] [--bake-s S] [--poll-s S]
+            [--dauc TOL] [--eval-npz FILE]
+        python -m xgboost_tpu deliver --connect HOST:PORT --status
+        python -m xgboost_tpu deliver --connect HOST:PORT --stop --model M
+    """
+    import json
+    import socket
+
+    usage = ("usage: python -m xgboost_tpu deliver --connect HOST:PORT "
+             "(--model M --watch DIR [opts] | --status | --stop "
+             "--model M)")
+    msg: Dict[str, Any] = {"op": "deliver"}
+    connect = None
+    flags = {"--model": ("model", str), "--watch": ("watch", str),
+             "--mode": ("mode", str), "--fraction": ("fraction", float),
+             "--min-requests": ("min_requests", int),
+             "--bake-s": ("bake_s", float), "--poll-s": ("poll_s", float),
+             "--dauc": ("dauc_tol", float),
+             "--p99-ratio": ("p99_ratio", float),
+             "--from-rounds": ("from_rounds", int),
+             "--eval-npz": ("eval_npz", str)}
+    i = 0
+    try:
+        while i < len(argv):
+            a = argv[i]
+            if a == "--connect":
+                i += 1
+                connect = argv[i]
+            elif a == "--status":
+                msg["action"] = "status"
+            elif a == "--stop":
+                msg["action"] = "stop"
+            elif a in flags:
+                key, conv = flags[a]
+                i += 1
+                msg[key] = conv(argv[i])
+            else:
+                raise ValueError(f"unknown deliver option: {a!r}")
+            i += 1
+        if connect is None:
+            raise ValueError("--connect HOST:PORT is required")
+        if msg.get("action", "start") == "start" \
+                and not (msg.get("model") and msg.get("watch")):
+            raise ValueError("starting a delivery needs --model and "
+                             "--watch")
+        host, _, port = connect.rpartition(":")
+        port = int(port)
+    except (ValueError, IndexError) as e:
+        print(f"deliver: {e}", file=sys.stderr)
+        print(usage, file=sys.stderr)
+        return 1
+    try:
+        with socket.create_connection((host or "127.0.0.1", port),
+                                      timeout=30) as s:
+            fh = s.makefile("rw", encoding="utf-8")
+            fh.write(json.dumps(msg) + "\n")
+            fh.flush()
+            line = fh.readline()
+    except OSError as e:
+        print(f"deliver: cannot reach {connect}: {e}", file=sys.stderr)
+        return 1
+    try:
+        resp = json.loads(line)
+    except ValueError:
+        print(f"deliver: bad response: {line!r}", file=sys.stderr)
+        return 1
+    print(json.dumps(resp, indent=2))
+    return 0 if not resp.get("error") else 1
 
 
 def main() -> None:  # console entry

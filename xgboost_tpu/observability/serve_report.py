@@ -42,9 +42,18 @@ from typing import Any, Dict, List, Optional, Tuple
 from . import fleet
 
 __all__ = ["load_server_obs", "summarize_access", "summarize_tenants",
-           "format_serve_report", "expand_server_dirs", "main"]
+           "summarize_delivery", "format_serve_report",
+           "expand_server_dirs", "main"]
 
 _REPLICA_RE = re.compile(r"^replica(\d+)$")
+
+#: timeline events emitted by the train-to-serve delivery loop
+#: (serving/delivery.py + the server's publish/promote/rollback/
+#: quarantine methods) — rendered as their own report section
+_DELIVERY_EVENTS = (
+    "checkpoint_seen", "checkpoint_skipped", "model_published",
+    "canary_start", "canary_rejected", "model_promoted",
+    "model_rolled_back", "model_quarantined", "model_discarded")
 
 
 def _resolve_dir(path: str) -> Optional[str]:
@@ -179,6 +188,26 @@ def summarize_tenants(access: List[Dict[str, Any]]) -> Dict[str, Any]:
     return out
 
 
+def summarize_delivery(events: List[Dict[str, Any]]
+                       ) -> List[Dict[str, Any]]:
+    """The delivery story in order: every checkpoint_seen / skipped /
+    published / canary / promote / rollback / quarantine event with its
+    args flattened — the machine-readable "Model delivery" section
+    (docs/serving.md)."""
+    rows: List[Dict[str, Any]] = []
+    for ev in events:
+        if ev.get("name") not in _DELIVERY_EVENTS:
+            continue
+        args = ev.get("args") or {}
+        row: Dict[str, Any] = {"unix_ms": ev.get("unix_ms"),
+                               "event": ev["name"]}
+        for k in sorted(args):
+            row.setdefault(k, args[k])
+        rows.append(row)
+    rows.sort(key=lambda r: r.get("unix_ms") or 0)
+    return rows
+
+
 def _timeline(access: List[Dict[str, Any]],
               events: List[Dict[str, Any]],
               dispatches: List[Dict[str, Any]],
@@ -230,7 +259,8 @@ def format_serve_report(summary: Dict[str, Any],
                         exemplars: List[Dict[str, Any]],
                         top: int = 8,
                         tenants: Optional[Dict[str, Any]] = None,
-                        replicas: Optional[List[Dict[str, Any]]] = None
+                        replicas: Optional[List[Dict[str, Any]]] = None,
+                        delivery: Optional[List[Dict[str, Any]]] = None
                         ) -> str:
     o = summary["outcomes"]
     shed_detail = ",".join(f"{k}={v}" for k, v in
@@ -291,6 +321,17 @@ def format_serve_report(summary: Dict[str, Any],
                 f"{t['rows']:>7} {t['total_p50_s'] * 1e3:>8.2f}ms "
                 f"{t['total_p99_s'] * 1e3:>8.2f}ms "
                 f"{t['queue_wait_p99_s'] * 1e3:>8.2f}ms  {sheds}")
+    if delivery:
+        lines.append("")
+        lines.append("model delivery (train-to-serve loop):")
+        base = next((r["unix_ms"] for r in delivery
+                     if r.get("unix_ms") is not None), 0)
+        for row in delivery:
+            t = ((row.get("unix_ms") or base) - base) / 1e3
+            detail = " ".join(
+                f"{k}={v}" for k, v in row.items()
+                if k not in ("unix_ms", "event") and v is not None)
+            lines.append(f"  t+{t:>6.1f}s {row['event']:<20} {detail}")
     if timeline:
         lines.append("")
         lines.append("shed/degrade timeline (1s buckets):")
@@ -398,11 +439,13 @@ def main(argv: List[str]) -> int:
     summary = summarize_access(access, dispatches)
     tenants = summarize_tenants(access)
     timeline = _timeline(access, events, dispatches)
+    delivery = summarize_delivery(events)
     exemplars = sorted((r for r in access if "total_s" in r),
                        key=lambda r: -r["total_s"])
     print(format_serve_report(summary, timeline, exemplars, top=top,
                               tenants=tenants,
-                              replicas=replicas if fleet_mode else None))
+                              replicas=replicas if fleet_mode else None,
+                              delivery=delivery))
 
     if fleet_mode:
         # one fleet-wide artifact set under the FIRST input's obs/ dir
@@ -415,6 +458,7 @@ def main(argv: List[str]) -> int:
         report_out = os.path.join(obs_dir, "fleet_serve_report.json")
         doc = {"summary": summary, "replicas": replicas,
                "tenants": tenants, "timeline": timeline,
+               "delivery": delivery,
                "exemplars": exemplars[:top],
                "rollup": fleet.rollup_metrics(all_obs)}
     else:
@@ -422,7 +466,8 @@ def main(argv: List[str]) -> int:
         trace_out = os.path.join(obs_dir, "serve.trace.json")
         report_out = os.path.join(obs_dir, "serve_report.json")
         doc = {"summary": summary, "tenants": tenants,
-               "timeline": timeline, "exemplars": exemplars[:top]}
+               "timeline": timeline, "delivery": delivery,
+               "exemplars": exemplars[:top]}
     try:
         fleet.write_trace(trace_out, fleet.merge_trace(all_obs))
         with open(report_out, "w") as f:

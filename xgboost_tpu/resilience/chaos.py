@@ -86,7 +86,7 @@ SITES = ("compile", "pallas", "collective", "pager_io", "native_load",
          "checkpoint_write", "gradient", "grow", "eval",
          "worker_kill", "heartbeat_drop", "collective_timeout",
          "serving_dispatch", "serving_model_load", "serving_swap",
-         "batcher_wedge")
+         "batcher_wedge", "delivery_publish", "canary_diff")
 
 
 class ChaosError(RuntimeError):

@@ -40,7 +40,7 @@ from . import chaos, policy
 __all__ = [
     "FORMAT", "checkpoint_path", "save_checkpoint", "read_checkpoint",
     "load_latest", "list_checkpoints", "process_dir", "inspect_dir",
-    "verify_checkpoint", "atomic_write_bytes",
+    "verify_checkpoint", "path_rounds", "atomic_write_bytes",
 ]
 
 FORMAT = "xgbtpu-ckpt-v1"
@@ -224,6 +224,20 @@ def verify_checkpoint(path: str) -> Tuple[bool, str, int]:
     if hashlib.sha256(payload).hexdigest() != header.get("sha256"):
         return False, "checksum mismatch (bit corruption)", rounds
     return True, "ok", rounds
+
+
+def path_rounds(path: str) -> Optional[int]:
+    """The rounds a checkpoint FILENAME advertises (``ckpt_<rounds>
+    .ckpt``) — no I/O at all. The delivery watcher's steady-state poll
+    primitive: with nothing new on disk, a poll must not re-read (let
+    alone re-hash) a multi-hundred-MB payload every second, so full
+    verification (:func:`verify_checkpoint`) runs only for files named
+    beyond the already-delivered mark. The name is a hint, never
+    trusted: anything it flags as new is fully verified — a corrupt
+    file named ``ckpt_00000007`` is caught (and counted) there, and the
+    authoritative rounds always come from the verified header."""
+    m = _NAME_RE.match(os.path.basename(path))
+    return int(m.group(1)) if m else None
 
 
 def inspect_dir(directory: str) -> List[dict]:

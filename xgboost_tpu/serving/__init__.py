@@ -26,6 +26,14 @@ the serving-side analog of the reference's bindings/frontends tier
   breakers, input quarantine, the batcher-worker watchdog, and the
   crash-only manifest/SIGTERM-drain contract (docs/serving.md
   "Failure handling");
+- :mod:`~xgboost_tpu.serving.delivery` — continuous train-to-serve
+  delivery (ISSUE 12): a controller that watches a training run_dir
+  through the verified checkpoint readers, publishes each new snapshot
+  as ``name@vN``, canaries it against live traffic (shadow or
+  fractional request_id-hash split), gates promotion on the live SLO
+  ledger + a held-out AUC parity gate, promotes by the warm hot swap
+  and auto-rolls back (+ quarantines) on a post-promotion breaker trip
+  (docs/serving.md "Model delivery");
 - :mod:`~xgboost_tpu.serving.fleet` — the scale-out tier (ISSUE 11):
   replica supervisor + consistent-hash routing front over N crash-only
   servers sharing one versioned manifest, with weighted-fair multi-
@@ -41,19 +49,23 @@ request", "Scaling out").
 
 from .admission import AdmissionController, RequestShed  # noqa: F401
 from .batcher import MicroBatcher  # noqa: F401
+from .delivery import (  # noqa: F401
+    CanaryRouter, CanaryState, DeliveryController,
+)
 from .faults import (  # noqa: F401
     CircuitBreaker, FaultDomain, Quarantine, RequestError,
 )
 from .obs import ServingRecorder, SLOLedger  # noqa: F401
 from .server import ModelServer, serve_main  # noqa: F401
-from .swap import hot_swap  # noqa: F401
+from .swap import hot_swap, promote_live  # noqa: F401
 from .tenancy import (  # noqa: F401
     ModelEntry, ModelRegistry, TenantFairQueue,
 )
 
 __all__ = [
-    "AdmissionController", "CircuitBreaker", "FaultDomain", "MicroBatcher",
+    "AdmissionController", "CanaryRouter", "CanaryState", "CircuitBreaker",
+    "DeliveryController", "FaultDomain", "MicroBatcher",
     "ModelEntry", "ModelRegistry", "ModelServer", "Quarantine",
     "RequestError", "RequestShed", "SLOLedger", "ServingRecorder",
-    "TenantFairQueue", "hot_swap", "serve_main",
+    "TenantFairQueue", "hot_swap", "promote_live", "serve_main",
 ]

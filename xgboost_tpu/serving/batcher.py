@@ -69,7 +69,8 @@ from . import faults
 from .admission import AdmissionController, RequestShed
 from .obs import RequestRecord, ServingRecorder
 from .tenancy import (
-    OVERFLOW_TENANT, QUEUE_STOP, ModelEntry, TenantFairQueue,
+    OVERFLOW_TENANT, QUEUE_STOP, SHADOW_TENANT, ModelEntry,
+    TenantFairQueue,
 )
 
 __all__ = ["MicroBatcher"]
@@ -438,6 +439,15 @@ class MicroBatcher:
 
         first = grp[0]
         domain = self.admission.faults
+        # shadow-canary isolation (serving/delivery.py): an all-shadow
+        # group must not feed the live fault plane — its failures belong
+        # to the CANARY verdict (attach_shadow observes them), not to the
+        # model's NAME-keyed breaker or the payload quarantine, or a bad
+        # candidate in shadow mode ("zero user impact") could shed live
+        # traffic / quarantine a live request's fingerprint. Shadow
+        # requests target the candidate entry, so they never coalesce
+        # with incumbent-bound live traffic.
+        shadow = all(r.tenant == SHADOW_TENANT for r in grp)
         rows = sum(r.n for r in grp)
         h0, m0 = self._cache_counts()
         t0 = time.perf_counter_ns()
@@ -447,6 +457,7 @@ class MicroBatcher:
             X = sub[0].X if len(sub) == 1 else \
                 np.concatenate([r.X for r in sub], axis=0)
             faults.check_poison(X)
+            faults.check_model_poison(first.entry.label)
             return first.entry.predict(
                 X, predict_type=first.predict_type,
                 iteration_range=first.iteration_range,
@@ -457,10 +468,12 @@ class MicroBatcher:
         # one dispatch() call; classification/retry/bisection only run
         # once a failure has already happened (the ≤2% overhead pin)
         ok, failed = faults.isolate_dispatch(
-            grp, dispatch, domain=domain, model=first.entry.name)
+            grp, dispatch, domain=None if shadow else domain,
+            model=first.entry.name)
         t1 = time.perf_counter_ns()
-        domain.breaker(first.entry.name).record(
-            ok=not failed, latency_s=(t1 - t0) / 1e9)
+        if not shadow:
+            domain.breaker(first.entry.name).record(
+                ok=not failed, latency_s=(t1 - t0) / 1e9)
         with self._lock:
             if self._gen != gen:
                 return  # watchdog already failed this batch's futures

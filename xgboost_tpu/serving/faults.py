@@ -67,10 +67,11 @@ __all__ = [
     "RequestError", "CircuitBreaker", "Quarantine", "FaultDomain",
     "CLOSED", "OPEN", "HALF_OPEN", "BREAKER_STATE_NAMES",
     "record_serving_fault", "isolate_dispatch", "fingerprint",
-    "check_poison",
+    "check_poison", "check_model_poison",
 ]
 
 _ENV_POISON = "XGBTPU_CHAOS_POISON"
+_ENV_MODEL_POISON = "XGBTPU_CHAOS_MODEL"
 _ENV_QUARANTINE_AFTER = "XGBTPU_QUARANTINE_AFTER"
 _ENV_BREAKER_WINDOW = "XGBTPU_BREAKER_WINDOW"
 _ENV_BREAKER_THRESHOLD = "XGBTPU_BREAKER_THRESHOLD"
@@ -165,6 +166,31 @@ def check_poison(X, site: str = DISPATCH_SITE) -> None:
         return
     if isinstance(X, np.ndarray) and bool(np.any(X == np.float32(value))):
         raise _PoisonError(site, value)
+
+
+class _ModelPoisonError(chaos.ChaosPermanent):
+    """A model-version poison hit: PERMANENT and sticky per label — the
+    scripted analog of a bad model version reaching production. Drives
+    the delivery controller's breaker-trip → auto-rollback path
+    deterministically (docs/serving.md "Model delivery")."""
+
+    def __init__(self, site: str, label: str):
+        super().__init__(site, 0)
+        self.args = (f"chaos: poisoned model version {label!r} "
+                     f"at site={site!r}",)
+
+
+def check_model_poison(label: str, site: str = DISPATCH_SITE) -> None:
+    """Raise a PERMANENT chaos fault when this dispatch's model label
+    (``name@vN``) is named by ``XGBTPU_CHAOS_MODEL`` (comma-separated
+    labels). Re-read per dispatch, so a test/CI driver can arm it AFTER
+    a promotion lands — a regression that only the promoted version
+    exhibits. One dict lookup when unarmed."""
+    raw = os.environ.get(_ENV_MODEL_POISON)
+    if not raw:
+        return
+    if label in {p.strip() for p in raw.split(",") if p.strip()}:
+        raise _ModelPoisonError(site, label)
 
 
 # ---------------------------------------------------------------------------

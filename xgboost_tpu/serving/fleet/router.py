@@ -282,8 +282,19 @@ class Router:
             if shutdown is not None:
                 shutdown()
             return self._with_id(rid, {"ok": True})
-        if op in ("load", "swap"):
+        if op in ("load", "swap", "promote", "rollback", "quarantine",
+                  "unload"):
+            # delivery control plane (ISSUE 12): publish/promote/rollback/
+            # quarantine converge every replica — the shared manifest
+            # covers any replica a broadcast missed (it restores lazily)
             return self._with_id(rid, self._broadcast(msg))
+        # anything else — predict, and a `deliver` op attaching a
+        # controller — runs on ONE replica. A controller attached through
+        # the router therefore publishes/promotes with broadcast=None:
+        # its decisions land in the shared manifest and reach the other
+        # replicas at their next restart/fault-in, not live (live fleet
+        # convergence needs the broadcast-wired controller the in-process
+        # `ModelServer.deliver(broadcast=...)` path sets up).
         return self._forward(msg)
 
     def _with_id(self, rid, out: Dict[str, Any]) -> Dict[str, Any]:

@@ -36,14 +36,21 @@ from ..observability import flight as _flight
 from ..observability.metrics import REGISTRY
 from .admission import AdmissionController, RequestShed
 from .batcher import MicroBatcher
-from .faults import FaultDomain
+from .delivery import SHADOW_TENANT, CanaryRouter, attach_shadow
+from .faults import FaultDomain, record_serving_fault
 from .obs import ServingRecorder
-from .swap import SwapRunner, warm_entry
+from .swap import SwapRunner, promote_live, warm_entry
 from .tenancy import ModelRegistry
 
 __all__ = ["ModelServer", "serve_main"]
 
 MANIFEST_FORMAT = "xgbtpu-manifest-v1"
+
+#: registry/swap events that change the retained source set (or the
+#: quarantine set) and therefore rewrite the crash-only manifest
+_MANIFEST_EVENTS = frozenset((
+    "model_load", "model_swap", "model_published", "model_promoted",
+    "model_rolled_back", "model_quarantined", "model_discarded"))
 
 
 class ModelServer:
@@ -85,6 +92,18 @@ class ModelServer:
             self.admission, obs=self.obs, max_wait_us=batch_wait_us,
             max_batch_rows=max_batch_rows, tenant_weights=tenant_weights)
         self._swapper = SwapRunner(self.registry, on_event=self._on_event)
+        #: the delivery plane (serving/delivery.py): active canaries per
+        #: model name, and the controllers driving them
+        self.canary = CanaryRouter()
+        self._deliveries: Dict[str, Any] = {}
+        self._quarantined: Dict[str, Dict[int, Dict[str, Any]]] = {}
+        # gate-rejected published versions dropped by discard_version:
+        # the manifest writer scrubs their rows + spilled bytes so a
+        # continuous-training loop rejecting candidates cannot grow the
+        # manifest or disk without bound (version numbers are never
+        # reused, so the tombstones stay valid for the process lifetime)
+        self._discarded: Dict[str, set] = {}
+        self._state_lock = threading.Lock()
         self._closed = False
         self._draining = False
         self._manifest_lock = threading.Lock()
@@ -96,24 +115,161 @@ class ModelServer:
 
     # ------------------------------------------------------------------
     def _on_event(self, name: str, **args: Any) -> None:
-        """Registry/swap event hook: timeline recording plus the
+        """Registry/swap/delivery event hook: timeline recording plus the
         crash-only manifest — every change to the retained source set
-        (load, swap) atomically rewrites ``run_dir/manifest.json`` so a
-        killed-and-restarted server re-faults its full model set."""
+        (load, swap, publish, promote, rollback, quarantine) atomically
+        rewrites ``run_dir/manifest.json`` so a killed-and-restarted
+        server re-faults its full model set with the same live pointers
+        and quarantine decisions."""
         self.obs.event(name, **args)
-        if name in ("model_load", "model_swap"):
+        if name in _MANIFEST_EVENTS:
             self._write_manifest()
 
     def load(self, name: str, source: Any, *,
-             version: Optional[int] = None, warm: bool = True) -> str:
-        """Load a model version and make it live. Returns ``name@vN``."""
+             version: Optional[int] = None, warm: bool = True,
+             make_live: bool = True) -> str:
+        """Load a model version; with ``make_live`` (default) the serving
+        pointer flips to it, otherwise the version is merely *published*
+        — resident and warm but not serving (the delivery controller's
+        canary staging). Returns ``name@vN``."""
         booster = source if hasattr(source, "save_raw") else None
         entry = self.registry.load(name, source, version=version,
-                                   booster=booster)
+                                   booster=booster, make_live=make_live)
         if warm:
             warm_entry(entry)
-        self._on_event("model_load", model=entry.label)
+        self._on_event("model_load" if make_live else "model_published",
+                       model=entry.label)
         return entry.label
+
+    def publish(self, name: str, source: Any, *,
+                version: Optional[int] = None, warm: bool = True) -> str:
+        """Publish a version without flipping the serving pointer:
+        ``load(..., make_live=False)`` — the staging half of delivery
+        (docs/serving.md "Model delivery")."""
+        return self.load(name, source, version=version, warm=warm,
+                         make_live=False)
+
+    def promote(self, name: str, version: int, *,
+                drain_timeout_s: float = 60.0) -> str:
+        """Flip the serving pointer to an already-published version (the
+        existing warm hot-swap: flip + drain; the load happened at
+        publish). Refuses quarantined versions. Returns ``name@vN``."""
+        version = int(version)
+        with self._state_lock:
+            if version in self._quarantined.get(name, {}):
+                raise ValueError(
+                    f"{name}@v{version} is quarantined (rolled back by "
+                    "delivery); it cannot be promoted")
+        return promote_live(
+            self.registry, name, version,
+            drain_timeout_s=drain_timeout_s, on_event=self._on_event,
+            event="model_promoted").label
+
+    def rollback(self, name: str, version: int, *,
+                 drain_timeout_s: float = 10.0) -> str:
+        """Re-swap to a previous (last-good) version — the delivery
+        controller's auto-rollback flip. Same machinery as promote, its
+        own timeline event. Returns ``name@vN``."""
+        return promote_live(
+            self.registry, name, int(version),
+            drain_timeout_s=drain_timeout_s, on_event=self._on_event,
+            event="model_rolled_back").label
+
+    def quarantine_version(self, name: str, version: int, *,
+                           rounds: Optional[int] = None) -> None:
+        """Quarantine one version: drop it from the arena AND its
+        retained source, record it in the manifest so a restarted server
+        (and the delivery watcher — it never re-promotes a quarantined
+        round) inherit the decision."""
+        version = int(version)
+        with self._state_lock:
+            self._quarantined.setdefault(name, {})[version] = {
+                "rounds": int(rounds) if rounds is not None else None,
+                "unix_ms": round(time.time() * 1e3, 3)}
+        self.registry.drop(name, version)
+        self._on_event("model_quarantined", model=f"{name}@v{version}",
+                       rounds=rounds)
+
+    def quarantined_versions(self, name: str) -> Dict[int, Dict[str, Any]]:
+        """version -> {rounds, unix_ms} for one model name."""
+        with self._state_lock:
+            return {v: dict(info) for v, info in
+                    self._quarantined.get(name, {}).items()}
+
+    def discard_version(self, name: str, version: int) -> None:
+        """Drop a published-but-never-promoted version (a gate-rejected
+        delivery candidate): arena entry, retained source, manifest row
+        and the spilled model bytes all go. Unlike quarantine this is
+        plain cleanup, not a verdict — the round may still be retrained
+        and arrive again as a NEW version. Refuses the live version."""
+        version = int(version)
+        if self.registry.live_version(name) == version:
+            raise ValueError(
+                f"{name}@v{version} is live; rollback before discarding")
+        with self._state_lock:
+            self._discarded.setdefault(name, set()).add(version)
+        self.registry.pin(name, version, False)
+        self.registry.drop(name, version)
+        # the spilled bytes go once, here; later manifest rewrites only
+        # scrub the ROW (the tombstone set is replayed against the
+        # read-merge-write doc, not against the filesystem)
+        if self._manifest_path:
+            try:
+                os.remove(os.path.join(
+                    os.path.dirname(self._manifest_path) or ".",
+                    "models", f"{name}@v{version}.json"))
+            except OSError:
+                pass
+        self._on_event("model_discarded", model=f"{name}@v{version}")
+
+    def durable_source(self, name: str, version: int) -> Optional[str]:
+        """The manifest-spilled copy of one published version
+        (``<manifest dir>/models/<name>@vN.json``) when it exists — what
+        a fleet publish broadcast ships instead of the training-owned
+        checkpoint path, so replicas keep a loadable source after
+        training retention prunes the original file."""
+        if not self._manifest_path:
+            return None
+        path = os.path.join(
+            os.path.dirname(self._manifest_path) or ".", "models",
+            f"{name}@v{int(version)}.json")
+        return path if os.path.exists(path) else None
+
+    # ------------------------------------------------------------------
+    # delivery controllers
+    # ------------------------------------------------------------------
+    def deliver(self, name: str, watch_dir: str, **kw: Any):
+        """Attach a delivery controller watching ``watch_dir`` for this
+        model name (one per name) and start it. Keyword args flow to
+        :class:`~xgboost_tpu.serving.delivery.DeliveryController`."""
+        from .delivery import DeliveryController
+
+        with self._state_lock:
+            if name in self._deliveries:
+                raise RuntimeError(
+                    f"a delivery controller is already watching {name!r}")
+        # construct OUTSIDE the state lock: the controller reads the
+        # server's quarantine table (same, non-reentrant lock) in __init__
+        ctl = DeliveryController(self, name, watch_dir, **kw)
+        with self._state_lock:
+            if name in self._deliveries:
+                raise RuntimeError(
+                    f"a delivery controller is already watching {name!r}")
+            self._deliveries[name] = ctl
+        return ctl.start()
+
+    def delivery_status(self) -> Dict[str, Any]:
+        with self._state_lock:
+            ctls = dict(self._deliveries)
+        return {name: ctl.status() for name, ctl in ctls.items()}
+
+    def stop_delivery(self, name: str) -> bool:
+        with self._state_lock:
+            ctl = self._deliveries.pop(name, None)
+        if ctl is None:
+            return False
+        ctl.stop()
+        return True
 
     def swap(self, name: str, source: Any, *,
              version: Optional[int] = None, block: bool = True,
@@ -187,7 +343,8 @@ class ModelServer:
             prev = {}
         models: Dict[str, Any] = {
             name: {"live": info.get("live"),
-                   "versions": dict(info.get("versions", {}))}
+                   "versions": dict(info.get("versions", {})),
+                   "quarantined": dict(info.get("quarantined", {}))}
             for name, info in (prev.get("models") or {}).items()
             if isinstance(info, dict)}
         live = self.registry.models()
@@ -208,10 +365,42 @@ class ModelServer:
                 except OSError:
                     continue  # unspillable source: not restartable
                 kind, payload = "file", path
-            doc = models.setdefault(name, {"live": None, "versions": {}})
+            doc = models.setdefault(
+                name, {"live": None, "versions": {}, "quarantined": {}})
             if name in live:
                 doc["live"] = live[name]
             doc["versions"][str(v)] = {"kind": kind, "path": payload}
+        # quarantine decisions win over everything: a quarantined version
+        # loses its retained source (and can never be the live pointer),
+        # on this replica's view AND whatever other replicas recorded
+        with self._state_lock:
+            quarantined = {name: {str(v): dict(info)
+                                  for v, info in q.items()}
+                           for name, q in self._quarantined.items()}
+        for name, q in quarantined.items():
+            doc = models.setdefault(
+                name, {"live": None, "versions": {}, "quarantined": {}})
+            doc.setdefault("quarantined", {}).update(q)
+        for name, doc in models.items():
+            for v_str in list(doc.get("quarantined", {})):
+                doc.get("versions", {}).pop(v_str, None)
+                if str(doc.get("live")) == v_str:
+                    doc["live"] = None
+        # discarded (gate-rejected, never-live) versions lose their row
+        # on every rewrite: the read-merge-write keeps versions other
+        # replicas recorded, so without the tombstone replay a slower
+        # replica's write would resurrect the row (their bytes went once
+        # in discard_version; the `unload` broadcast drops other
+        # replicas' copies).
+        with self._state_lock:
+            discarded = {name: sorted(vs)
+                         for name, vs in self._discarded.items()}
+        for name, versions in discarded.items():
+            doc = models.get(name)
+            if doc is None:
+                continue
+            for v in versions:
+                doc.get("versions", {}).pop(str(v), None)
         _flight.atomic_write_json(
             self._manifest_path,
             {"format": MANIFEST_FORMAT, "pid": os.getpid(),
@@ -234,7 +423,23 @@ class ModelServer:
         restored = 0
         for name, info in doc.get("models", {}).items():
             live_v = info.get("live")
+            quarantined = set(info.get("quarantined", {}) or {})
+            for v_str, q in (info.get("quarantined") or {}).items():
+                try:
+                    with self._state_lock:
+                        self._quarantined.setdefault(name, {})[
+                            int(v_str)] = dict(q) if isinstance(q, dict) \
+                            else {"rounds": None}
+                    # a quarantined version's row was scrubbed, so the
+                    # registry cannot learn its number from the sources
+                    # below — reserve it, or the next publish would be
+                    # assigned a quarantined (unpromotable) version
+                    self.registry.reserve_version(name, int(v_str))
+                except (TypeError, ValueError):
+                    continue
             for v_str, spec in info.get("versions", {}).items():
+                if v_str in quarantined:
+                    continue  # a quarantined version never serves again
                 try:
                     self.registry.register_source(
                         name, int(v_str), (spec["kind"], spec["path"]),
@@ -284,8 +489,19 @@ class ModelServer:
         rec.tenant = tenant
         deadline = (time.monotonic() + deadline_ms / 1e3
                     if deadline_ms is not None else None)
+        # delivery canary (serving/delivery.py): requests whose version
+        # the caller did not pin may be re-routed to the candidate
+        # (fraction mode — deterministic request_id-hash split) or
+        # duplicated to it (shadow mode, below). One dict read when no
+        # canary is active.
+        state = self.canary.active(name) if version is None else None
+        route_version = version
+        if state is not None:
+            cv = state.route_version(rec.id)
+            if cv is not None:
+                route_version = cv
         try:
-            entry = self.registry.get(name, version)
+            entry = self.registry.get(name, route_version)
         except KeyError as e:
             # unknown model: still one access-log line per request
             rec.model = name
@@ -293,11 +509,52 @@ class ModelServer:
             e.request_id = rec.id
             raise
         rec.model = entry.label
-        return self.batcher.submit(
+        fut = self.batcher.submit(
             entry, data, predict_type=predict_type,
             iteration_range=iteration_range, missing=missing,
             base_margin=base_margin, deadline=deadline, rec=rec,
             tenant=tenant)
+        if state is not None:
+            which = "candidate" if entry.version == state.version \
+                else "incumbent"
+            state.watch_future(fut, which)
+            if which == "incumbent" and state.should_shadow(rec.id):
+                self._shadow_request(
+                    state, name, data, fut, rec.id,
+                    predict_type=predict_type,
+                    iteration_range=iteration_range, missing=missing,
+                    base_margin=base_margin)
+        return fut
+
+    def _shadow_request(self, state, name: str, data, primary_fut,
+                        rid: str, *, predict_type, iteration_range,
+                        missing, base_margin) -> None:
+        """Duplicate one sampled live request to the canary candidate
+        (shadow mode): the duplicate rides the normal batcher on the
+        ``_canary`` tenant lane with its own ``<id>~shadow`` access-log
+        record; its outcome feeds the candidate arm and the output pair
+        is diffed (``delivery.attach_shadow``). The live response is
+        never touched — a shed or failed shadow only counts as
+        ``shadow_dropped``."""
+        try:
+            cand = self.registry.get(name, state.version)
+            srec = self.obs.start_request(f"{rid}~shadow", None)
+            srec.tenant = SHADOW_TENANT
+            srec.model = cand.label
+            sfut = self.batcher.submit(
+                cand, data, predict_type=predict_type,
+                iteration_range=iteration_range, missing=missing,
+                base_margin=base_margin, rec=srec, tenant=SHADOW_TENANT)
+        except RequestShed:
+            state.note_shadow_dropped()
+            return
+        except Exception as e:
+            # a shadow must never surface into the live request path:
+            # classify (site canary_shadow) and drop the duplicate
+            record_serving_fault("canary_shadow", e)
+            state.note_shadow_dropped()
+            return
+        attach_shadow(state, primary_fut, sfut)
 
     def predict(self, name: str, data, *,
                 timeout: Optional[float] = 60.0, **kw) -> np.ndarray:
@@ -315,7 +572,7 @@ class ModelServer:
         exemplars) — the JSONL protocol's view of the ledger without
         scraping ``metrics``."""
         self.obs.drain()  # barrier: include every completed request
-        return {
+        out = {
             "arena": self.registry.stats(),
             "queue_depth": self.batcher.queue_depth(),
             "p99_s": self.admission.p99_s(),
@@ -323,10 +580,29 @@ class ModelServer:
             "faults": self.faults.snapshot(),
             "draining": self._draining,
         }
+        canaries = self.canary.snapshot()
+        if canaries:
+            out["canaries"] = canaries
+        with self._state_lock:
+            has_delivery = bool(self._deliveries)
+            quarantined = {n: sorted(q) for n, q in
+                           self._quarantined.items() if q}
+        if has_delivery:
+            out["delivery"] = self.delivery_status()
+        if quarantined:
+            out["quarantined"] = quarantined
+        return out
 
     def close(self, drain: bool = True) -> None:
         if not self._closed:
             self._closed = True
+            # delivery controllers first: they drive canaries/promotions
+            # through the batcher being shut down below
+            with self._state_lock:
+                ctls = list(self._deliveries.values())
+                self._deliveries.clear()
+            for ctl in ctls:
+                ctl.stop()
             self.batcher.close(drain=drain)
             # seal the flight recorder last: the black box carries the
             # final SLO summary and every drained request's access line
@@ -374,12 +650,30 @@ def _handle(server: ModelServer, msg: Dict[str, Any],
             out["result"] = np.asarray(result, np.float64).tolist()
         elif op == "load":
             out["version"] = server.load(
-                msg["model"], msg["path"], version=msg.get("version"))
+                msg["model"], msg["path"], version=msg.get("version"),
+                make_live=bool(msg.get("live", True)))
             out["ok"] = True
         elif op == "swap":
             out["version"] = server.swap(
                 msg["model"], msg["path"], version=msg.get("version"))
             out["ok"] = True
+        elif op == "promote":
+            out["version"] = server.promote(msg["model"],
+                                            int(msg["version"]))
+            out["ok"] = True
+        elif op == "rollback":
+            out["version"] = server.rollback(msg["model"],
+                                             int(msg["version"]))
+            out["ok"] = True
+        elif op == "quarantine":
+            server.quarantine_version(msg["model"], int(msg["version"]),
+                                      rounds=msg.get("rounds"))
+            out["ok"] = True
+        elif op == "unload":
+            server.discard_version(msg["model"], int(msg["version"]))
+            out["ok"] = True
+        elif op == "deliver":
+            out.update(_handle_deliver(server, msg))
         elif op == "metrics":
             out["metrics"] = server.metrics()
         elif op == "stats":
@@ -408,9 +702,40 @@ def _handle(server: ModelServer, msg: Dict[str, Any],
     return out
 
 
+def _handle_deliver(server: ModelServer, msg: Dict[str, Any]
+                    ) -> Dict[str, Any]:
+    """The ``deliver`` protocol op: attach/inspect/stop a delivery
+    controller over the wire. ``action``: ``start`` (default; ``model``
+    + ``watch`` required, optional ``mode``/``fraction``/
+    ``min_requests``/``bake_s``/``poll_s``/``dauc_tol``/``eval_npz`` — an
+    ``.npz`` with arrays ``X``/``y`` arming the AUC gate), ``status``,
+    ``stop``."""
+    action = msg.get("action", "start")
+    if action == "status":
+        return {"ok": True, "delivery": server.delivery_status()}
+    if action == "stop":
+        return {"ok": server.stop_delivery(msg["model"])}
+    if action != "start":
+        return {"error": f"unknown deliver action: {action!r}"}
+    kw: Dict[str, Any] = {}
+    for key, conv in (("mode", str), ("fraction", float),
+                      ("min_requests", int), ("bake_s", float),
+                      ("poll_s", float), ("dauc_tol", float),
+                      ("p99_ratio", float), ("from_rounds", int),
+                      ("canary_deadline_s", float)):
+        if msg.get(key) is not None:
+            kw[key] = conv(msg[key])
+    if msg.get("eval_npz"):
+        with np.load(msg["eval_npz"]) as npz:
+            kw["eval_data"] = (np.asarray(npz["X"], np.float32),
+                               np.asarray(npz["y"]))
+    server.deliver(msg["model"], msg["watch"], **kw)
+    return {"ok": True, "model": msg["model"], "watch": msg["watch"]}
+
+
 def _parse_serve_args(argv: List[str]) -> Dict[str, Any]:
-    opts: Dict[str, Any] = {"models": {}, "port": None, "stdin": False,
-                            "host": "127.0.0.1"}
+    opts: Dict[str, Any] = {"models": {}, "deliver": {}, "port": None,
+                            "stdin": False, "host": "127.0.0.1"}
     flags = {"--port": ("port", int), "--arena-mb": ("arena_mb", float),
              "--batch-wait-us": ("batch_wait_us", int),
              "--max-queue": ("max_queue", int), "--host": ("host", str),
@@ -427,6 +752,12 @@ def _parse_serve_args(argv: List[str]) -> Dict[str, Any]:
             if not sep:
                 raise ValueError("--model takes name=path")
             opts["models"][name] = path
+        elif a == "--deliver":
+            i += 1
+            name, sep, watch = argv[i].partition("=")
+            if not sep:
+                raise ValueError("--deliver takes name=watch_dir")
+            opts["deliver"][name] = watch
         elif a in flags:
             key, conv = flags[a]
             i += 1
@@ -450,7 +781,8 @@ def serve_main(argv: List[str], stdin=None, stdout=None) -> int:
     except (ValueError, IndexError) as e:
         print(f"serve: {e}", file=sys.stderr)
         print("usage: python -m xgboost_tpu serve (--port N | --stdin) "
-              "[--model name=path ...] [--arena-mb M] [--batch-wait-us U] "
+              "[--model name=path ...] [--deliver name=watch_dir ...] "
+              "[--arena-mb M] [--batch-wait-us U] "
               "[--max-queue Q] [--host H] [--run-dir D] [--manifest F]",
               file=sys.stderr)
         return 1
@@ -462,6 +794,8 @@ def serve_main(argv: List[str], stdin=None, stdout=None) -> int:
         batch_wait_us=opts.get("batch_wait_us"),
         run_dir=opts.get("run_dir"),
         manifest_path=opts.get("manifest_path"))
+    for name, watch in opts["deliver"].items():
+        server.deliver(name, watch)
 
     def respond(obj: Dict[str, Any], fh) -> None:
         fh.write(json.dumps(obj) + "\n")

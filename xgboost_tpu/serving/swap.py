@@ -34,16 +34,26 @@ import numpy as np
 from ..observability.metrics import REGISTRY
 from .tenancy import ModelEntry, ModelRegistry
 
-__all__ = ["hot_swap", "warm_entry"]
+__all__ = ["hot_swap", "warm_entry", "promote_live"]
 
 
 def warm_entry(entry: ModelEntry) -> None:
     """Compile/load the serving program for this entry's forest shape by
     predicting one NaN row (pads to the minimum bucket; NaN rows walk
     default directions — no data needed). Failures propagate: a model
-    whose program cannot build must fail the swap, not the first caller."""
+    whose program cannot build must fail the swap, not the first caller.
+
+    The warm predict runs under an UNLABELLED serving context: its
+    compile-heavy latency sample must not land in the model's
+    ``predict_latency_seconds{model=}`` series — that series feeds the
+    admission p99 estimate and the delivery canary's p99 gate, and a
+    single warm outlier would dominate a young version's tail."""
+    from ..predictor.serving import serving_context
+
     F = max(1, entry.booster.num_features())
-    entry.predict(np.full((1, F), np.nan, np.float32))
+    with serving_context():
+        entry.booster.inplace_predict(
+            np.full((1, F), np.nan, np.float32))
 
 
 def hot_swap(registry: ModelRegistry, name: str, source: Any, *,
@@ -111,6 +121,44 @@ def _hot_swap(registry: ModelRegistry, name: str, source: Any, *,
     if on_event is not None:
         on_event("model_swap", model=entry.label,
                  old_version=old_version)
+    return entry
+
+
+def promote_live(registry: ModelRegistry, name: str, version: int, *,
+                 warm: bool = True, drain_timeout_s: float = 60.0,
+                 on_event=None, event: str = "model_promoted"
+                 ) -> ModelEntry:
+    """Flip ``name``'s serving pointer to an ALREADY-published resident
+    version — the promote/rollback half of the delivery loop
+    (``serving/delivery.py``). Same warm → flip → drain sequence as
+    :func:`hot_swap`, but against a version the registry already holds
+    (published with ``make_live=False``), so nothing is loaded from disk
+    on the flip path; a rollback to a pinned incumbent is warm by
+    construction. Counts into ``model_swaps_total`` — a promotion IS a
+    swap, just one whose load happened at publish time."""
+    entry = registry.get(name, version)
+    if warm:
+        warm_entry(entry)
+    old_version = registry.live_version(name)
+    registry.set_live(name, entry.version)
+    if old_version is not None and old_version != entry.version:
+        try:
+            old = registry.get(name, version=old_version)
+        except KeyError:
+            old = None
+        if old is not None and not old.drain(drain_timeout_s):
+            from ..utils import console_logger
+
+            console_logger.warning(
+                f"{event} {entry.label}: old snapshot v{old_version} "
+                f"still has {old.inflight} in-flight request(s) after "
+                f"{drain_timeout_s}s; leaving it pinned")
+    REGISTRY.counter(
+        "model_swaps_total",
+        "Completed zero-downtime model swaps").labels(
+            model=entry.label).inc()
+    if on_event is not None:
+        on_event(event, model=entry.label, old_version=old_version)
     return entry
 
 

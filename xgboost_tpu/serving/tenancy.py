@@ -56,7 +56,8 @@ from ..observability.metrics import REGISTRY
 
 __all__ = ["ModelEntry", "ModelRegistry", "resolve_source", "load_booster",
            "TenantFairQueue", "tenant_weights", "tenant_quotas",
-           "tenant_quota", "QUEUE_STOP", "OVERFLOW_TENANT"]
+           "tenant_quota", "QUEUE_STOP", "OVERFLOW_TENANT",
+           "SHADOW_TENANT"]
 
 _ENV_WEIGHTS = "XGBTPU_TENANT_WEIGHTS"
 _ENV_QUOTA = "XGBTPU_TENANT_QUOTA"
@@ -66,6 +67,13 @@ _ENV_TENANT_MAX = "XGBTPU_TENANT_MAX"
 #: to — wire-supplied tenant names must not grow per-tenant server state
 #: (metric children, ledger caches, fair-queue lanes) without bound
 OVERFLOW_TENANT = "overflow"
+
+#: the tenant lane shadow-canary duplicates ride (serving/delivery.py).
+#: The batcher recognizes it to keep shadow traffic OUT of the live
+#: fault plane: an all-shadow dispatch group feeds neither the model's
+#: NAME-keyed breaker nor the payload quarantine — a bad candidate must
+#: fail its canary, never shed live traffic.
+SHADOW_TENANT = "_canary"
 
 
 def _env_float(name: str, default: float) -> float:
@@ -335,6 +343,12 @@ class ModelEntry:
         self.booster = booster
         self.spec = spec
         self.nbytes = nbytes
+        #: eviction shield (ISSUE 12): a pinned entry is skipped by the
+        #: LRU budget pass — the delivery controller pins the canary AND
+        #: the incumbent for the whole canary window, so a hot third
+        #: tenant cannot evict the incumbent mid-canary and turn a
+        #: rollback into a cold fault-in. Set via ModelRegistry.pin().
+        self.pinned = False
         self._cv = threading.Condition()
         self._inflight = 0
 
@@ -513,6 +527,26 @@ class ModelRegistry:
         with self._lock:
             return dict(self._sources)
 
+    def reserve_version(self, name: str, version: int) -> None:
+        """Make future auto-assigned versions start beyond ``version``.
+        The restart path reserves QUARANTINED version numbers: their
+        manifest rows are scrubbed (so ``register_source`` never sees
+        them), and without the reservation the next published checkpoint
+        would be assigned a quarantined number — unpromotable forever."""
+        with self._lock:
+            self._next_version[name] = max(
+                int(version), self._next_version.get(name, 0))
+
+    def pin(self, name: str, version: int, pinned: bool = True) -> None:
+        """Shield (or release) one resident version from LRU eviction.
+        The delivery controller pins canary + incumbent for the canary
+        window (docs/serving.md "Model delivery"); pinning a non-resident
+        version is a no-op — the next fault-in loads it unpinned."""
+        with self._lock:
+            entry = self._entries.get((name, int(version)))
+            if entry is not None:
+                entry.pinned = bool(pinned)
+
     def set_live(self, name: str, version: int) -> ModelEntry:
         """Atomically flip the serving pointer (the entry must exist)."""
         with self._lock:
@@ -572,9 +606,10 @@ class ModelRegistry:
     def _evict_to_budget_locked(self, keep: Tuple[str, int]) -> List[str]:
         """Drop least-recently-used entries until under budget. The entry
         being installed is exempt (a model bigger than the whole budget
-        still serves — the arena just holds nothing else). In-flight
-        entries are skipped this pass: their memory is pinned by the
-        requests anyway, and dropping the registry's reference would only
+        still serves — the arena just holds nothing else). In-flight and
+        explicitly pinned entries (delivery canaries) are skipped this
+        pass: their memory is held by the requests / the canary anyway,
+        and dropping the registry's reference would only
         hide the bytes from the gauge. Returns the evicted labels so the
         caller can emit timeline events after releasing the lock."""
         evicted: List[str] = []
@@ -587,7 +622,7 @@ class ModelRegistry:
             if key == keep:
                 continue
             entry = self._entries[key]
-            if entry.inflight:
+            if entry.inflight or entry.pinned:
                 continue
             del self._entries[key]
             total -= entry.nbytes

@@ -68,18 +68,31 @@ def train(
     resume_from: Optional[str] = None,
     checkpoint_interval: int = 1,
     checkpoint_shared: bool = False,
+    resume_mode: str = "total",
 ) -> Booster:
     """``resume_from`` (ISSUE 5 tentpole): a directory of crash-safe
     checkpoints. When set, training (a) resumes from the newest VERIFIED
     checkpoint found there — rerunning the same command after a crash
     picks up at the last committed round and grows the same trees as an
     uninterrupted run — and (b) commits an atomic checkpoint every
-    ``checkpoint_interval`` rounds. ``num_boost_round`` stays the TOTAL
-    round count: a run resumed at round r trains the remaining
-    ``num_boost_round - r``. ``checkpoint_shared`` keeps multi-process
+    ``checkpoint_interval`` rounds. With the default
+    ``resume_mode="total"``, ``num_boost_round`` stays the TOTAL round
+    count: a run resumed at round r trains the remaining
+    ``num_boost_round - r``. ``resume_mode="append"`` (ISSUE 12 —
+    continuous training) instead trains ``num_boost_round`` MORE rounds
+    on top of the checkpoint, on possibly FRESH ``dtrain`` data:
+    boosting is naturally incremental, so periodic append-mode re-trains
+    against the same directory plus the serving delivery controller form
+    a real online-learning loop (docs/serving.md "Model delivery").
+    ``train(N)`` then append-resume ``+M`` on the same data is
+    bit-identical to ``train(N + M)`` straight through
+    (tests/test_delivery.py). ``checkpoint_shared`` keeps multi-process
     checkpoints in ONE directory (the elastic layer's mode — payloads are
     rank-identical and tmp names pid-unique) instead of per-rank
     subdirectories."""
+    if resume_mode not in ("total", "append"):
+        raise ValueError(
+            f"resume_mode must be 'total' or 'append', got {resume_mode!r}")
     callbacks = list(callbacks) if callbacks else []
     evals = list(evals) if evals else []
     feval = custom_metric if custom_metric is not None else feval
@@ -99,10 +112,14 @@ def train(
         if loaded is not None and xgb_model is None:
             raw, done_rounds = loaded
             xgb_model = bytes(raw)
-            # total-round semantics: an already-complete checkpoint trains
-            # 0 further rounds (but still flows through the normal path so
-            # caches/callbacks see the same state as a live run)
-            num_boost_round = max(0, num_boost_round - done_rounds)
+            if resume_mode == "total":
+                # total-round semantics: an already-complete checkpoint
+                # trains 0 further rounds (but still flows through the
+                # normal path so caches/callbacks see the same state as a
+                # live run)
+                num_boost_round = max(0, num_boost_round - done_rounds)
+            # append semantics: num_boost_round MORE rounds from here —
+            # the continuous-training half of the delivery loop
         callbacks.append(_AtomicCheckpoint(ckpt_dir, checkpoint_interval))
 
     if verbose_eval:
