@@ -743,10 +743,13 @@ def _report_arithmetic_intensity() -> None:
               flush=True)
 
 
-def _report_stage_breakdown(stages0: dict, label: str) -> None:
-    """Per-stage wall-clock deltas (sketch/grow/eval/checkpoint) from the
-    flight recorder since ``stages0`` — where the measured loop's time
-    went, by phase (ISSUE 7 satellite)."""
+def _report_stage_breakdown(stages0: dict, label: str) -> dict:
+    """Per-stage wall-clock deltas (sketch/grow/eval/checkpoint/sync) from
+    the flight recorder since ``stages0`` — where the measured loop's time
+    went, by phase (ISSUE 7 satellite). Returns the delta dict so the
+    caller can fold it into the BENCH JSONL line itself (ISSUE 13
+    satellite: the trajectory file records where each run spends a round,
+    not just stderr)."""
     try:
         from xgboost_tpu.observability import flight
 
@@ -755,14 +758,16 @@ def _report_stage_breakdown(stages0: dict, label: str) -> None:
                  for k in sorted(set(now) | set(stages0))}
         delta = {k: v for k, v in delta.items() if v > 0}
         if not delta:
-            return
+            return {}
         print(f"# stage breakdown [{label}]: "
               + " ".join(f"{k}={v:.2f}s" for k, v in delta.items()),
               file=sys.stderr, flush=True)
         _log_partial({"config": f"stages_{label}", "stage_seconds": delta})
+        return delta
     except Exception as e:
         print(f"# stage breakdown skipped: {e}", file=sys.stderr,
               flush=True)
+        return {}
 
 
 def _run_configs(args, suffix: str, final: dict) -> None:
@@ -923,7 +928,19 @@ def _run_configs(args, suffix: str, final: dict) -> None:
     rps = done / measured if measured > 0 else 0.0
     print(f"# [max_bin={primary_bin}] rounds/s: {rps:.2f}  test-auc: {auc:.4f}",
           file=sys.stderr, flush=True)
-    _report_stage_breakdown(stages0, f"bin{primary_bin}")
+    stages_delta = _report_stage_breakdown(stages0, f"bin{primary_bin}")
+    # the BENCH line itself carries the per-stage split + pipeline depth
+    # (ISSUE 13 satellite): the trajectory file shows WHERE a round's time
+    # went (grow dispatch vs pipeline sync vs sketch/eval), not just that
+    # it moved
+    if stages_delta:
+        final["stages"] = stages_delta
+    try:
+        from xgboost_tpu.pipeline import pipeline_depth
+
+        final["pipeline_depth"] = pipeline_depth()
+    except Exception:
+        pass
     _log_partial({"config": f"bin{primary_bin}", "rows": rows,
                   "rounds_done": done, "seconds": round(measured, 3),
                   "auc": None if auc != auc else round(auc, 5),

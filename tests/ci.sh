@@ -146,6 +146,51 @@ print(f"chaos smoke OK: {len(plan.fired)} injected faults absorbed, "
       "fault history in exposition")
 EOF
 
+# Pipelined-round fault surfacing (ISSUE 13 satellite): a seeded fault
+# fires INSIDE a pipelined round at the executor's sync point. It must
+# come back attributed to the round that was being synced (on the
+# exception and in the flight event stream), the checkpoint chain must
+# stay consistent (resume completes, bit-identical to a clean run).
+XGBTPU_CHAOS="pipeline_sync:transient:2" \
+XGBTPU_PIPELINE_DEPTH=2 python - <<'EOF'
+import tempfile
+
+import numpy as np
+
+import xgboost_tpu as xgb
+from xgboost_tpu.observability import flight
+from xgboost_tpu.resilience.chaos import ChaosError
+
+rng = np.random.RandomState(0)
+X = rng.randn(2000, 6).astype(np.float32)
+y = (X[:, 0] > 0).astype(np.float32)
+ck = tempfile.mkdtemp()
+params = {"objective": "binary:logistic", "max_depth": 3, "max_bin": 16,
+          "verbosity": 0}
+err = None
+try:
+    xgb.train(params, xgb.DMatrix(X, label=y), 6, verbose_eval=False,
+              resume_from=ck, checkpoint_interval=1)
+except ChaosError as e:
+    err = e
+assert err is not None, "pipeline_sync chaos never fired"
+assert getattr(err, "pipeline_round", None) is not None, \
+    "fault not attributed to a round at the sync point"
+faults = [r for r in flight.RECORDER.records()
+          if r.get("t") == "event" and r.get("name") == "pipeline_fault"]
+assert faults and faults[0]["args"]["round"] == err.pipeline_round, faults
+# the abort committed the consistent prefix; resume completes the run...
+bst = xgb.train(params, xgb.DMatrix(X, label=y), 6, verbose_eval=False,
+                resume_from=ck, checkpoint_interval=1)
+assert bst.num_boosted_rounds() == 6
+# ...bit-identical to an uninterrupted run (the chaos schedule is spent)
+clean = xgb.train(params, xgb.DMatrix(X, label=y), 6, verbose_eval=False)
+assert bst.save_raw() == clean.save_raw(), \
+    "resume after a pipelined-round fault diverged from a clean run"
+print(f"pipelined-round chaos OK: fault at sync attributed to round "
+      f"{err.pipeline_round}, checkpoint chain consistent")
+EOF
+
 echo "=== tier 1.6: elastic chaos lane (seeded worker_kill + obs-report) ==="
 # A 2-process gloo training run with XGBTPU_CHAOS="worker_kill:..." armed
 # on rank 1: the scripted SIGKILL mid-round must drive the full elastic

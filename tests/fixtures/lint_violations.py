@@ -54,3 +54,9 @@ def swallowed_dispatch_failure(entry, X):
         return entry.predict(X)
     except Exception:  # RS502: broad swallow on the serving dispatch path
         return None  # neither re-raised nor classified via resilience.policy
+
+
+def round_loop_fixture_root(bst, dtrain, margin):
+    bst.update(dtrain, 0)
+    margin.block_until_ready()  # RH204: host sync inside the round loop
+    return margin

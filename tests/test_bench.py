@@ -53,7 +53,15 @@ def test_bench_produces_json_lines():
     # training metric first, serving (predict) metric second
     assert len(lines) == 2, out.stdout
     rec = json.loads(lines[0])
-    assert set(rec) == {"metric", "value", "unit", "vs_baseline"}
+    # ISSUE 13 satellite: the BENCH line itself carries the per-stage
+    # breakdown and the pipeline depth, so the trajectory file shows
+    # where each run spends a round
+    assert {"metric", "value", "unit", "vs_baseline"} <= set(rec)
+    assert set(rec) <= {"metric", "value", "unit", "vs_baseline",
+                        "stages", "pipeline_depth"}
+    assert rec["pipeline_depth"] >= 0
+    assert rec["stages"] and all(v > 0 for v in rec["stages"].values())
+    assert "grow" in rec["stages"], rec["stages"]
     assert rec["unit"] == "s" and rec["value"] > 0
     assert rec["metric"].startswith("train_time_12kx50_4r_depth6")
     # off-baseline workload (12k != 1M rows): ratio must not pose as speedup

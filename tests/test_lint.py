@@ -32,8 +32,10 @@ def test_package_lints_clean_against_baseline():
     assert not stale, f"stale baseline entries: {stale}"
     # the baseline is a ratchet, not a landfill: it must stay small
     # (raised 25 -> 35 with RS502: the observability/protocol swallows
-    # under serving/ are individually justified survivors)
-    assert len(suppressed) < 35
+    # under serving/ are individually justified survivors; 35 -> 48 with
+    # RH204: the custom-objective / re-sketch / one-time-diagnostic syncs
+    # on the round path are contractual host consumers, each justified)
+    assert len(suppressed) < 48
 
 
 def test_baseline_entries_all_justified():

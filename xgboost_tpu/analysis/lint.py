@@ -67,6 +67,7 @@ ALL_RULES = {
     "RH201": "non-static scalar/config parameter on a jit'd function",
     "RH202": "traced function closes over module-level mutable state",
     "RH203": "jax.jit created inside a function body",
+    "RH204": "host sync inside the round loop outside a blessed sync point",
     "DT301": "float64 dtype passed into a jnp op",
     "DT302": "np.float64 literal in device-adjacent code",
     "CC401": "module-level mutable state mutated outside a lock",
@@ -88,6 +89,35 @@ _RS501_NAMES = {"psum", "psum_scatter", "all_gather", "all_to_all",
                 "sync_global_devices"}
 _RS501_ROOTS = {"jax", "lax", "multihost_utils"}
 _RS501_EXEMPT = "collective.py"
+
+# RH204: the pipelined executor's contract (ISSUE 13) — the training
+# round loop never blocks the host outside the blessed sync points
+# (``pipeline.RoundPipeline``'s admit/drain, the eval/checkpoint/callback
+# boundaries). A stray ``.block_until_ready()`` / ``np.asarray`` /
+# ``float(<call>)`` inside the round-loop call graph silently serializes
+# the pipeline: every round pays the device round-trip the async executor
+# exists to overlap. The walk starts at the named round-loop roots,
+# follows calls WITHIN the round-loop-owned modules (the eval/checkpoint/
+# callback layers are themselves sync boundaries and are not entered),
+# and skips ``pipeline.py`` — it IS the sync point. Justified syncs (the
+# legacy host-prune path, custom-objective gradients) live in the
+# baseline, not in code exemptions. Fixture/test roots: any function
+# whose name starts with ``round_loop`` counts as a root.
+_RH204_ROOTS = {
+    ("training.py", "train"),
+    ("learner.py", "Booster.update"),
+    ("learner.py", "Booster.update_many"),
+    ("learner.py", "Booster._update"),
+    ("learner.py", "Booster._do_boost"),
+    ("learner.py", "Booster.boost"),
+}
+_RH204_SCOPE_FILES = (
+    "training.py", "learner.py", "gbm/gbtree.py", "tree/grow_fused.py",
+    "tree/grow.py", "tree/hist_kernel.py", "pipeline.py",
+)
+_RH204_BLESSED_FILE = "pipeline.py"
+_RH204_SYNC_METHODS = {"block_until_ready"}
+_RH204_NP_MATERIALIZERS = {"asarray", "array"}
 
 # RS502: a bare ``except Exception`` swallow on the serving dispatch
 # path hides a failure from the resilience layer — it neither retries,
@@ -1023,6 +1053,71 @@ def _pass_collectives(project: _Project) -> List[Finding]:
     return out
 
 
+def _rh204_is_sync(node: ast.Call) -> Optional[str]:
+    """Why ``node`` is a host sync (message fragment), or None."""
+    chain = _attr_chain(node.func)
+    if chain and chain[-1] in _RH204_SYNC_METHODS:
+        return f"'.{chain[-1]}()'"
+    if chain and len(chain) >= 2 and chain[0] in ("np", "numpy") \
+            and chain[-1] in _RH204_NP_MATERIALIZERS:
+        return f"'{'.'.join(chain)}(...)'"
+    if isinstance(node.func, ast.Name) and node.func.id in ("float", "int") \
+            and node.args and isinstance(node.args[0], ast.Call):
+        return f"'{node.func.id}(<call>)'"
+    return None
+
+
+def _pass_round_loop_sync(project: _Project) -> List[Finding]:
+    """RH204: walk the round-loop call graph from the named roots (calls
+    resolved within the round-loop-owned modules only; eval/checkpoint/
+    callback layers are sync boundaries by contract) and flag host-sync
+    expressions outside ``pipeline.py``."""
+    out: List[Finding] = []
+    in_scope = {}
+    for mod in project.modules:
+        if mod.in_package and any(
+                mod.relpath.endswith("xgboost_tpu/" + s)
+                for s in _RH204_SCOPE_FILES):
+            in_scope[id(mod)] = mod
+    roots: List[_Func] = []
+    for mod in project.modules:
+        for qn, fn in mod.funcs.items():
+            if qn.split(".")[-1].startswith("round_loop"):
+                roots.append(fn)  # fixture/test convention
+            for suffix, root_qn in _RH204_ROOTS:
+                if mod.relpath.endswith("xgboost_tpu/" + suffix) \
+                        and qn == root_qn:
+                    roots.append(fn)
+    seen: Set[int] = set()
+    work = list(roots)
+    while work:
+        fn = work.pop()
+        if id(fn) in seen:
+            continue
+        seen.add(id(fn))
+        blessed = fn.module.relpath.endswith(
+            "xgboost_tpu/" + _RH204_BLESSED_FILE)
+        symbols = _symbol_index(fn.module)
+        for node in ast.walk(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            why = None if blessed else _rh204_is_sync(node)
+            if why is not None:
+                out.append(Finding(
+                    "RH204", fn.module.relpath, node.lineno,
+                    symbols.get(node.lineno, fn.qualname),
+                    f"host sync {why} inside the round-loop call graph: "
+                    f"the pipelined executor (XGBTPU_PIPELINE_DEPTH) "
+                    f"only overlaps rounds the host does not block on — "
+                    f"sync at the blessed points (pipeline.drain, eval/"
+                    f"checkpoint boundaries) or add a justified baseline "
+                    f"entry"))
+            callee = _resolve_call(project, fn, node)
+            if callee is not None and id(callee.module) in in_scope:
+                work.append(callee)
+    return out
+
+
 def _pass_serving_excepts(project: _Project) -> List[Finding]:
     """RS502: ``except Exception``/``except BaseException`` handlers under
     ``serving/`` (outside ``serving/faults.py``) that neither re-raise nor
@@ -1097,6 +1192,7 @@ def lint_paths(paths: Optional[Sequence[str]] = None,
     findings += _pass_dtype(project)
     findings += _pass_concurrency(project)
     findings += _pass_collectives(project)
+    findings += _pass_round_loop_sync(project)
     findings += _pass_serving_excepts(project)
     if rules:
         findings = [f for f in findings if f.rule in rules]

@@ -48,7 +48,7 @@ __all__ = ["Op", "init", "finalize", "get_rank", "get_world_size",
            "is_distributed", "allreduce", "broadcast", "communicator_print",
            "get_processor_name", "tracker_print", "version_number",
            "CollectiveError", "guarded", "process_allgather", "psum",
-           "all_gather"]
+           "all_gather", "reduce_histogram"]
 
 #: default deadline (seconds) for one guarded host-side collective; a
 #: healthy allgather completes in milliseconds-to-seconds, so ten minutes
@@ -163,6 +163,102 @@ class Op(IntEnum):
     SUM = 2
 
 
+# ---------------------------------------------------------------------------
+# Hierarchical/quantized histogram reduction (ISSUE 13 satellite): a SUM
+# reduction that cuts wire bytes by narrowing the payload dtype when that
+# is provably lossless. Two stages ("hierarchical"): a tiny fixed-width
+# metadata agreement round (per-rank max magnitude + f32 grid exactness),
+# then the payload round at the agreed narrow wire dtype. Exactness rules:
+#
+# - integer payloads: int64/int32 narrow to the smallest signed type whose
+#   range holds every rank's values AND the P-way sum (bin COUNTS fit
+#   int16 wire whenever max_count * world < 2^15 — "where bin counts
+#   allow"); integers re-widen exactly, and the sum runs in int64.
+# - f32 payloads: requantized onto a shared power-of-two grid (int16 wire)
+#   only when every rank's values sit EXACTLY on that grid (checked
+#   locally, agreed globally); the integer wire sum then dequantizes to
+#   the exact mathematical sum. Anything else ships as f32 unchanged.
+#
+# Either way the result is bit-identical to the full-precision reduction —
+# pinned by tests/test_pipeline.py's exact-requantization test — and
+# ``collective_bytes_total`` records the NARROW bytes actually shipped
+# (the multichip dryrun prints the naive-vs-quantized byte ratio).
+# ---------------------------------------------------------------------------
+
+def _grid_lsb_exp(arr: np.ndarray) -> float:
+    """Exponent of the largest power of two dividing EVERY value of
+    ``arr`` (+inf when all-zero): the finest grid the values sit on."""
+    nz = np.abs(arr[arr != 0].astype(np.float64))
+    if nz.size == 0:
+        return np.inf
+    mant, exp = np.frexp(nz)  # nz = mant * 2^exp, mant in [0.5, 1)
+    m_int = np.rint(mant * (1 << 53)).astype(np.int64)
+    low_bit = (m_int & -m_int).astype(np.float64)  # 2^trailing_zeros
+    return float((exp - 53 + np.log2(low_bit)).min())
+
+
+def reduce_histogram(data, *, site: str):
+    """Guarded cross-process SUM of a histogram-shaped array with a
+    hierarchically agreed, lossless-narrowed wire format. Identity
+    single-process (bytes still accounted at the narrow width, so the
+    dryrun can report the naive-vs-quantized ratio).
+
+    Stage 1 gathers 2 metadata doubles per rank (max magnitude + finest
+    value-grid exponent); stage 2 ships the payload at the narrowest
+    exact dtype: integers drop to int16/int32 when the GLOBAL range fits,
+    f32 requantizes to int16 on the global grid ``2^glsb`` whenever
+    ``gmax / 2^glsb < 2^15`` (true for count-valued and fixed-point
+    histograms — "where bin counts allow"); the wire sum runs in int64 and
+    dequantizes to the exact mathematical sum (exact in f32 up to 2^24
+    grid units). Ineligible payloads ship unchanged. Either way the
+    result is the exact sum — pinned by the exact-requantization test."""
+    arr = np.asarray(data)
+    world = get_world_size()
+    is_int = arr.dtype.kind in "iu"
+    m_local = float(np.abs(arr.astype(np.float64)).max()) if arr.size else 0.0
+    e_local = _grid_lsb_exp(arr) if not is_int else 0.0
+    if world > 1:
+        meta = process_allgather(
+            np.asarray([m_local, e_local], np.float64), site=f"{site}_meta")
+        gmax = float(np.asarray(meta)[:, 0].max())
+        glsb_e = float(np.asarray(meta)[:, 1].min())
+    else:
+        gmax, glsb_e = m_local, e_local
+    wire_dt, scale = arr.dtype, None
+    if is_int:
+        for dt in (np.int16, np.int32):
+            if np.dtype(dt).itemsize < arr.dtype.itemsize \
+                    and gmax < np.iinfo(dt).max:
+                wire_dt = np.dtype(dt)
+                break
+    elif arr.dtype == np.float32:
+        if gmax == 0.0:
+            wire_dt, scale = np.dtype(np.int16), 1.0
+        elif np.isfinite(glsb_e) and gmax / 2.0 ** glsb_e < 2 ** 15:
+            wire_dt, scale = np.dtype(np.int16), float(2.0 ** glsb_e)
+    if scale is not None:
+        wire = np.rint(arr.astype(np.float64) / scale).astype(wire_dt)
+    elif wire_dt != arr.dtype:
+        wire = arr.astype(wire_dt)
+    else:
+        wire = arr
+    gathered = np.asarray(process_allgather(wire, site=site))  # [P, ...]
+    if world == 1:
+        gathered = wire[None]
+    if np.dtype(wire_dt).kind in "iu":
+        total = gathered.astype(np.int64).sum(axis=0)
+    else:
+        total = gathered.sum(axis=0)
+    if scale is not None:
+        return (total.astype(np.float64) * scale).astype(arr.dtype)
+    if arr.dtype.kind in "iu":
+        # integer sums keep int64 (np.sum's promotion — the dtype the
+        # unquantized allreduce path always returned): narrowing back to
+        # the input dtype could silently wrap a cross-rank sum
+        return total.astype(np.int64)
+    return total.astype(arr.dtype)
+
+
 def init(**args) -> None:
     """No-op when the JAX runtime is already initialized (the reference's
     rabit.init role is played by ``parallel.init_distributed``)."""
@@ -203,6 +299,13 @@ def allreduce(data: np.ndarray, op: int = Op.SUM) -> np.ndarray:
         return arr
     from .observability import trace
 
+    if Op(op) == Op.SUM and arr.dtype.kind in "iuf" and arr.nbytes >= 1024:
+        # large SUM payloads take the hierarchical/quantized wire format
+        # (exact; falls back to full precision per payload) — the rabit
+        # shim is the path ported reference code syncs histograms over
+        with trace.span("allreduce", bytes=int(arr.nbytes), op=int(op),
+                        quantized=True):
+            return reduce_histogram(arr, site="allreduce")
     with trace.span("allreduce", bytes=int(arr.nbytes), op=int(op)):
         gathered = process_allgather(arr, site="allreduce")  # [P,...]
     red = {Op.SUM: np.sum, Op.MAX: np.max, Op.MIN: np.min}[Op(op)]

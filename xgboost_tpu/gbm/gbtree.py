@@ -22,6 +22,7 @@ from ..observability import REGISTRY as _REGISTRY, trace as _trace
 from ..params import GBTreeParam, TrainParam
 from ..predictor import StackedForest, predict_leaf, predict_margin, stack_forest
 from ..registry import BOOSTERS
+from ..analysis.retrace import guard_jit
 from ..tree.grow import GrowParams, grow_tree, leaf_value_map, prune_heap
 from ..tree.grow_fused import GrownTree, grow_tree_fused
 from ..tree.model import RegTree
@@ -34,6 +35,24 @@ def _hist_seconds():
         "hist_build_seconds",
         "Host-side wall time of one tree build dispatch "
         "(hist + split + partition)")
+
+
+@functools.partial(guard_jit, name="margin_add", static_argnames=("k",),
+                   donate_argnames=("m",))
+def _margin_add_jit(m, delta, *, k=None):
+    if k is None:
+        return m + delta
+    return m.at[:, k].add(delta)
+
+
+def _margin_add(margin_cache, delta, k):
+    """Per-round prediction-cache update with the OLD margin donated: the
+    round's cache buffer is updated in place instead of allocating a fresh
+    [n, K] every round (ISSUE 13 donation tentpole). The caller must treat
+    the passed-in cache as dead (every call site rebinds)."""
+    if margin_cache.ndim == 2:
+        return _margin_add_jit(margin_cache, delta, k=k)
+    return _margin_add_jit(margin_cache, delta)
 
 
 class _PendingTree:
@@ -610,9 +629,10 @@ def _obj_fingerprint(obj) -> tuple:
     )
 
 
-@functools.partial(jax.jit,
+@functools.partial(guard_jit, name="scan_rounds",
                    static_argnames=("obj", "obj_fp", "cfg", "n", "n_pad",
-                                    "n_groups", "n_parallel"))
+                                    "n_groups", "n_parallel"),
+                   donate_argnames=("m_pad",))
 def _scan_rounds_impl(binsf, label, weight, m_pad, iters, cut_vals, eta,
                       gamma, fw, seed_base, onehot=None, *, obj, obj_fp,
                       cfg, n, n_pad, n_groups, n_parallel=1):
@@ -620,7 +640,12 @@ def _scan_rounds_impl(binsf, label, weight, m_pad, iters, cut_vals, eta,
     tree(s) -> margin update (one tree per output group, like DoBoost's
     per-group gradient slicing, gbtree.cc:219). Cache key includes the
     objective INSTANCE (its params are read at trace time) and the static
-    grow config; equal-length chunks reuse the compile."""
+    grow config; equal-length chunks reuse the compile. The carried margin
+    is DONATED (ISSUE 13: async executor + donation): each chunk's margin
+    buffer is reused in place instead of re-allocated, so the steady-state
+    live-buffer count is flat across a whole training run — the caller's
+    input margin is dead after the call (update_many re-points the cache
+    at the returned one)."""
     K = n_groups
 
     def pad0(v):
@@ -652,9 +677,10 @@ def _scan_rounds_impl(binsf, label, weight, m_pad, iters, cut_vals, eta,
     return jax.lax.scan(body, m_pad, iters)
 
 
-@functools.partial(jax.jit,
+@functools.partial(guard_jit, name="scan_rounds_lossguide",
                    static_argnames=("obj", "obj_fp", "cfg", "n_groups",
-                                    "max_leaves"))
+                                    "max_leaves"),
+                   donate_argnames=("m_cur",))
 def _scan_rounds_lossguide_impl(bins, label, weight, m_cur, iters, cut_vals,
                                 eta, gamma, fw, seed_base, *, obj, obj_fp,
                                 cfg, n_groups, max_leaves):
@@ -1146,10 +1172,7 @@ class GBTree:
                         delta = delta_full
                         if use_mesh and delta.shape[0] != binned.n_rows:
                             delta = delta[: binned.n_rows]
-                        if margin_cache.ndim == 2:
-                            margin_cache = margin_cache.at[:, k].add(delta)
-                        else:
-                            margin_cache = margin_cache + delta
+                        margin_cache = _margin_add(margin_cache, delta, k)
                     continue
                 else:
                     t0 = _time.perf_counter()
@@ -1189,10 +1212,7 @@ class GBTree:
                     delta = jnp.asarray(lmap_np)[positions]
                     if use_mesh and delta.shape[0] != binned.n_rows:
                         delta = delta[: binned.n_rows]  # drop inert padding
-                    if margin_cache.ndim == 2:
-                        margin_cache = margin_cache.at[:, k].add(delta)
-                    else:
-                        margin_cache = margin_cache + delta
+                    margin_cache = _margin_add(margin_cache, delta, k)
         return new_trees, margin_cache
 
     # ------------------------------------------------------------------
@@ -1248,10 +1268,7 @@ class GBTree:
                 new_trees.append(tree)
                 if margin_cache is not None:
                     delta = jnp.asarray(lmap_np)[heap.positions]
-                    if margin_cache.ndim == 2:
-                        margin_cache = margin_cache.at[:, k].add(delta)
-                    else:
-                        margin_cache = margin_cache + delta
+                    margin_cache = _margin_add(margin_cache, delta, k)
         return new_trees, margin_cache
 
     # ------------------------------------------------------------------
@@ -1404,6 +1421,11 @@ class GBTree:
                     pad = jnp.zeros((n_pad - n,), jnp.float32)
                     g = jnp.concatenate([g, pad])
                     h = jnp.concatenate([h, pad])
+                elif self.gbtree_param.num_parallel_tree > 1:
+                    # hess is DONATED into the grow program; parallel trees
+                    # re-pass the same slice, so each call needs its own
+                    # buffer to give up
+                    h = jnp.copy(h)
                 return grow_tree_fused(
                     binsf, g, h, cut_vals, key,
                     float(tp.eta), float(tp.gamma), cfg, fw, onehot,
@@ -1427,11 +1449,8 @@ class GBTree:
                                       cat_mask)
                 new_trees.append(grown)
                 if margin_cache is not None:
-                    delta = grown.delta[:n]
-                    if margin_cache.ndim == 2:
-                        margin_cache = margin_cache.at[:, k].add(delta)
-                    else:
-                        margin_cache = margin_cache + delta
+                    margin_cache = _margin_add(margin_cache, grown.delta[:n],
+                                               k)
         return new_trees, margin_cache
 
     def scan_rounds_supported(self, binned, obj, n_groups: int) -> bool:

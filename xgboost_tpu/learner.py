@@ -87,6 +87,9 @@ class Booster:
         self.attributes_: Dict[str, str] = {}
         self.best_iteration: Optional[int] = None
         self.best_score: Optional[float] = None
+        # bounded in-flight window for pipelined update_many chunks
+        # (pipeline.RoundPipeline, created lazily; never pickled)
+        self._pipeline = None
         self.monitor = Monitor("Booster")
         if params:
             self._apply_params(dict(params))
@@ -266,7 +269,10 @@ class Booster:
                 self.update(dtrain, i)
             return
         from .observability import flight as _flight
+        from .pipeline import RoundPipeline, completion_probe
 
+        if self._pipeline is None:
+            self._pipeline = RoundPipeline()
         entry = self._caches.setdefault(id(dtrain), _PredCache())
         done = 0
         while done < num_rounds:
@@ -289,6 +295,10 @@ class Booster:
                 fault.inject("gradient")
                 fault.inject("grow")
                 margin = self._cached_margin(dtrain)
+                # detach before the chunk donates the carried margin: an
+                # abort mid-chunk must not leave a deleted buffer in the
+                # cache (see _do_boost)
+                entry.margin = None
                 info = dtrain.info
                 _t0 = time.perf_counter()
                 margin = self._gbm.boost_rounds_scan(
@@ -301,6 +311,19 @@ class Booster:
                     _flight.note("grow", time.perf_counter() - _t0)
                 entry.margin = margin
                 entry.num_trees = self._gbm.model.num_trees
+                # pipelined chunks (ISSUE 13): the dispatch above is
+                # async — admit its output and only block once more than
+                # XGBTPU_PIPELINE_DEPTH chunks are in flight, so chunk
+                # i+1's host work (gradient staging, dispatch) overlaps
+                # chunk i's device execution with a pinned memory
+                # watermark. An async fault surfaces here attributed to
+                # the chunk's first round (sync time -> 'sync' stage).
+                try:
+                    self._pipeline.admit(start_iteration + done,
+                                         completion_probe(margin))
+                except BaseException:
+                    self._pipeline.abandon()  # younger chunks are dead too
+                    raise
                 _REGISTRY.counter(
                     "rounds_total", "Boosting rounds dispatched").inc(k)
                 done += k
@@ -358,9 +381,11 @@ class Booster:
                         "grow_local_histmaker supports numerical features "
                         "only (the reference's local maker predates "
                         "categorical support)")
+                margin_cache = entry.margin
+                entry.margin = None  # donated below; see the gbtree branch
                 with self.monitor.section("BoostOneRound"):
                     _, new_margin = self._gbm.local_boost_one_round(
-                        X_raw, grad, hess, iteration, entry.margin,
+                        X_raw, grad, hess, iteration, margin_cache,
                         feature_weights=dtrain.info.feature_weights)
                 if new_margin is not None:
                     entry.margin = new_margin
@@ -400,9 +425,16 @@ class Booster:
                 else:
                     binned = dtrain.get_binned(self._gbm.train_param.max_bin, dtrain.info.weight)
             fw = dtrain.info.feature_weights
+            # detach the cache entry for the duration of the round: the
+            # margin buffer is DONATED into the round's margin update, and
+            # an abort mid-round (chaos fault, watchdog, Ctrl-C) must not
+            # leave a deleted array reachable through the cache (the
+            # incremental catch-up in _predict_margin would read it)
+            margin_cache = entry.margin
+            entry.margin = None
             with self.monitor.section("BoostOneRound"):
                 _, new_margin = self._gbm.boost_one_round(
-                    binned, grad, hess, iteration, entry.margin,
+                    binned, grad, hess, iteration, margin_cache,
                     feature_weights=fw,
                 )
             if new_margin is not None:

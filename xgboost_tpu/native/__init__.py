@@ -40,10 +40,10 @@ def _san_enabled() -> bool:
     return os.environ.get("XGBTPU_SAN") == "1"
 
 
-_SAN_FLAGS = [
+_SAN_FLAGS = (
     "-fsanitize=address,undefined", "-fno-sanitize-recover=all",
     "-fno-omit-frame-pointer", "-g", "-Wall", "-Wextra", "-Werror",
-]
+)
 
 
 def _lib_variant(lib_path: str) -> str:
@@ -79,7 +79,7 @@ def _compile(src: str, lib_path: str, extra: list, timeout: int = 120) -> bool:
     if os.path.exists(lib_path) and             os.path.getmtime(lib_path) >= os.path.getmtime(src):
         return True
     if _san_enabled():
-        extra = list(extra) + _SAN_FLAGS
+        extra = list(extra) + list(_SAN_FLAGS)
     cmd = ["g++", "-shared", "-fPIC", "-o", lib_path, src] + extra
     try:
         # ``native_load`` chaos site: a scripted fault here exercises the
@@ -263,6 +263,41 @@ def get_serving_lib() -> Optional[ctypes.CDLL]:
         lib.sv_predict_csr.restype = c.c_int
         _sv_lib = lib
         return _sv_lib
+
+
+_HB_SRC = os.path.join(_HERE, "hist_build.cpp")
+_HB_LIB = os.path.join(_HERE, "libhistbuild.so")
+_hb_lib: Optional[ctypes.CDLL] = None
+_hb_tried = False
+
+
+def get_hist_lib() -> Optional[ctypes.CDLL]:
+    """Load (building on demand) the native level-histogram + partition
+    kernel (``hist_build.cpp`` — the GHistBuilder analog the CPU training
+    fallback dispatches as an XLA FFI custom call; ``tree/hist_kernel.py``
+    registers the exported ``XgbtpuHbLevel``/``XgbtpuHbPartition`` handler
+    symbols). None when the toolchain or the jaxlib FFI headers are
+    unavailable (callers fall back to the XLA segment_sum path)."""
+    global _hb_lib, _hb_tried
+    with _lock:
+        if _hb_lib is not None or _hb_tried:
+            return _hb_lib
+        _hb_tried = True
+        try:
+            from jax.extend import ffi as _jffi
+
+            inc = _jffi.include_dir()
+        except Exception:
+            return None
+        lp = _lib_variant(_HB_LIB)
+        if not _compile(_HB_SRC, lp,
+                        ["-O3", "-march=native", "-std=c++17", f"-I{inc}"]):
+            return None
+        try:
+            _hb_lib = ctypes.CDLL(lp)
+        except OSError:
+            return None
+        return _hb_lib
 
 
 _CAPI_SRC = os.path.join(_HERE, "c_api.cpp")

@@ -1,0 +1,164 @@
+"""Async pipelined training executor (ISSUE 13 tentpole).
+
+JAX dispatch is asynchronous: a round's device work is enqueued and the
+host returns immediately. The classic round loop never exploited that —
+every consumer (eval, checkpoint, the bench's drain) blocked right after
+dispatch — and, worse, a loop with NO consumer would enqueue hundreds of
+rounds ahead, growing the in-flight buffer watermark without bound.
+
+:class:`RoundPipeline` makes the overlap an explicit, *bounded* contract:
+
+- ``admit(round_idx, handles)`` registers a dispatched round's output
+  arrays (the margin cache / delta — anything whose readiness implies the
+  round finished) WITHOUT blocking. When more than ``depth`` rounds are
+  in flight, the oldest is synced first, so at most ``depth`` rounds of
+  device buffers ever coexist (memory watermarks stay pinned while round
+  *i*'s dispatch overlaps round *i-1*'s execution).
+- ``drain()`` synchronizes everything outstanding — the blessed host
+  sync points are eval / checkpoint / callback boundaries and the end of
+  training (docs/perf.md, "The pipelined executor"); lint rule RH204
+  fences stray syncs inside the round loop.
+- a failed async round (chaos fault, OOM, poisoned input) surfaces at the
+  sync point; the pipeline re-raises it with the ORIGINATING round
+  attributed — on the exception (``.pipeline_round``), in the flight
+  recorder's event stream, and in the ``sync`` stage of the open round
+  record — instead of as an anonymous XlaRuntimeError rounds later.
+
+``XGBTPU_PIPELINE_DEPTH`` bounds the in-flight window (default 2;
+``0``/``1`` degrade gracefully: 0 = synchronous, every round blocks —
+the escape hatch; 1 = single round in flight). Wall time spent waiting
+inside the pipeline is charged to the flight recorder's ``sync`` stage,
+so the per-round stage split shows dispatch (``grow``) shrinking and the
+overlap window absorbing the rest.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from collections import deque
+from typing import Any, Deque, List, Optional, Tuple
+
+__all__ = ["RoundPipeline", "pipeline_depth", "completion_probe"]
+
+_ENV_DEPTH = "XGBTPU_PIPELINE_DEPTH"
+_DEFAULT_DEPTH = 2
+
+
+def pipeline_depth() -> int:
+    """The configured in-flight round bound (>= 0)."""
+    try:
+        return max(0, int(os.environ.get(_ENV_DEPTH, _DEFAULT_DEPTH)))
+    except ValueError:
+        return _DEFAULT_DEPTH
+
+
+def completion_probe(arr):
+    """A tiny dependent value whose readiness implies ``arr``'s producing
+    round finished. Needed because the round outputs themselves (the
+    margin cache) are DONATED into the next round's program — blocking on
+    the original buffer later would raise "donated buffer". The probe is
+    enqueued before the donation, so it is immune; its VALUE is never
+    read (only readiness), so even an in-place overwrite racing the read
+    is harmless. Failure still propagates: a faulted round poisons the
+    probe, so the sync point sees the error attributed to the right
+    round."""
+    if arr is None:
+        return None
+    try:
+        view = arr[:1, :1] if getattr(arr, "ndim", 1) >= 2 else arr[:1]
+        return view + 0
+    except Exception:
+        return arr
+
+
+class RoundPipeline:
+    """Bounded in-flight window over asynchronously dispatched rounds.
+
+    Not thread-safe: owned by one training loop. Handles are jax arrays;
+    anything without ``block_until_ready`` is ignored (None-safe), so
+    callers can pass whatever per-round outputs they have."""
+
+    def __init__(self, depth: Optional[int] = None) -> None:
+        self.depth = pipeline_depth() if depth is None else max(0, depth)
+        self._inflight: Deque[Tuple[int, List[Any]]] = deque()
+
+    def __len__(self) -> int:
+        return len(self._inflight)
+
+    # ------------------------------------------------------------------
+    def admit(self, round_idx: int, handles: Any) -> None:
+        """Register round ``round_idx``'s output arrays; sync the oldest
+        in-flight round(s) first if the window is full. With depth 0 the
+        round is synced immediately (synchronous mode)."""
+        hs = [h for h in (handles if isinstance(handles, (list, tuple))
+                          else [handles]) if h is not None]
+        self._inflight.append((int(round_idx), hs))
+        while len(self._inflight) > max(self.depth, 0):
+            self._sync_oldest()
+
+    def drain(self) -> None:
+        """Blessed sync point: block until every admitted round's device
+        work has finished (eval/checkpoint/callback boundaries, end of
+        training)."""
+        while self._inflight:
+            self._sync_oldest()
+
+    def abandon(self) -> None:
+        """Drop in-flight bookkeeping without syncing (abort paths where
+        the error already surfaced and re-syncing would re-raise)."""
+        self._inflight.clear()
+
+    # ------------------------------------------------------------------
+    def _sync_oldest(self) -> None:
+        round_idx, hs = self._inflight.popleft()
+        t0 = time.perf_counter()
+        try:
+            # chaos site: a scripted hit stands in for an async device
+            # fault surfacing at this sync point — the ci chaos lane pins
+            # that it comes back attributed to THIS round and that the
+            # checkpoint chain stays consistent
+            from .resilience import chaos
+
+            chaos.hit("pipeline_sync")
+            for h in hs:
+                ready = getattr(h, "block_until_ready", None)
+                if ready is None:
+                    continue
+                try:
+                    ready()
+                except Exception as e:
+                    # a handle donated into a LATER round's program is
+                    # superseded, not failed: the chain's data dependency
+                    # means a younger sync covers it (callers normally
+                    # admit completion_probe()s, which never hit this)
+                    if "donated" in str(e) or "deleted" in str(e):
+                        continue
+                    raise
+        except Exception as e:
+            # the async failure belongs to THIS round, not to whichever
+            # later host line happened to touch a device value first
+            self._attribute(round_idx, e)
+            try:
+                e.pipeline_round = round_idx  # type: ignore[attr-defined]
+            except Exception:
+                pass
+            raise
+        finally:
+            waited = time.perf_counter() - t0
+            from .observability import flight
+
+            flight.note("sync", waited)
+
+    @staticmethod
+    def _attribute(round_idx: int, exc: BaseException) -> None:
+        try:
+            from .observability import flight, trace
+
+            flight.RECORDER.event(
+                "pipeline_fault", round=int(round_idx),
+                error=type(exc).__name__, detail=str(exc)[:200])
+            trace.instant("pipeline_fault", round=int(round_idx),
+                          error=type(exc).__name__)
+        except Exception:
+            pass  # attribution must never mask the fault itself

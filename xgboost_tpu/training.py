@@ -148,6 +148,7 @@ def train(
 
     from .observability import flight as _flight
     from .observability import trace as _trace
+    from .pipeline import RoundPipeline, completion_probe
     from .resilience.watchdog import watchdog as _watchdog
 
     def _commit_on_abort() -> None:
@@ -178,9 +179,41 @@ def train(
             with _trace.span("train", rounds=num_boost_round, path="scan"):
                 with _watchdog("train_dispatch"):
                     bst.update_many(dtrain, start_round, num_boost_round)
+                if bst._pipeline is not None:
+                    # end-of-training sync point: the last chunks' async
+                    # faults must surface HERE, attributed, not as an
+                    # anonymous error at a later save/predict (direct
+                    # update_many callers keep cross-call pipelining and
+                    # drain at their own boundaries)
+                    bst._pipeline.drain()
         else:
+            # the async pipelined round loop (ISSUE 13): each round's
+            # dispatch overlaps the previous rounds' device execution,
+            # bounded to XGBTPU_PIPELINE_DEPTH rounds in flight. Host
+            # synchronization happens ONLY at the blessed points — an
+            # eval/early-stop/custom-callback boundary, a checkpoint
+            # commit, or the end of training — so a consumer-free run
+            # never blocks inside the loop (docs/perf.md).
+            pipe = RoundPipeline()
+            # per-round consumers force a drain every round; when the ONLY
+            # consumer is the auto-added interval checkpoint, drain only on
+            # the rounds it actually commits — a checkpoint_interval=k run
+            # keeps the overlap window on the other k-1 rounds
+            _other_consumers = (
+                bool(evals) or obj is not None or feval is not None
+                or early_stopping_rounds is not None
+                or any(not isinstance(c, (EvaluationMonitor,
+                                          _AtomicCheckpoint))
+                       for c in callbacks))
+            _ckpt_cb = ckpt_dir is not None
+
+            def _round_consumer(i: int) -> bool:
+                if _other_consumers:
+                    return True
+                return _ckpt_cb and (i + 1) % max(checkpoint_interval,
+                                                  1) == 0
             with _trace.span("train", rounds=num_boost_round,
-                             path="per_round"):
+                             path="per_round", pipeline_depth=pipe.depth):
                 for i in range(start_round, start_round + num_boost_round):
                     if container.before_iteration(bst, i, dtrain, evals):
                         break
@@ -196,16 +229,26 @@ def train(
                             _t0 = time.perf_counter()
                             with _watchdog("round_dispatch"):
                                 bst.update(dtrain, i, fobj=obj)
-                            # host-blocked time around the round dispatch:
-                            # the number ROADMAP 3's async executor exists
-                            # to shrink, recorded per round from day one
+                            # host-blocked dispatch time: the number the
+                            # pipelined executor exists to shrink; waits
+                            # land in the 'sync' stage instead
                             _flight.note("grow", time.perf_counter() - _t0)
+                            _entry = bst._caches.get(id(dtrain))
+                            pipe.admit(i, completion_probe(
+                                _entry.margin if _entry is not None
+                                else None))
+                            if _round_consumer(i):
+                                # sync point: the consumer must observe a
+                                # finished round (and an async fault must
+                                # surface HERE, attributed to its round)
+                                pipe.drain()
                             stop = container.after_iteration(
                                 bst, i, dtrain, evals, feval=feval)
                     finally:
                         _flight.RECORDER.end_round()
                     if stop:
                         break
+                pipe.drain()  # end-of-training sync point
     except BaseException as e:
         # ANY abort mid-loop — watchdog expiry, a collective failing
         # because a peer died, an elastic guard raising WorkerLost —

@@ -45,7 +45,13 @@ from .grow import (
     exact_k_subset,
     interaction_allowed,
 )
-from .hist_kernel import TR, fused_level, leaf_delta, partition_apply_xla
+from .hist_kernel import (
+    TR,
+    fused_level,
+    leaf_delta,
+    partition_apply,
+    partition_apply_xla,
+)
 from .param import RT_EPS, calc_weight
 
 __all__ = ["GrownTree", "grow_tree_fused", "pad_rows"]
@@ -111,7 +117,8 @@ def _constraint_consts(cfg: GrowParams, F: int):
     return mono_j, gmask
 
 
-def _init_state(cfg: GrowParams, F: int, G0, H0, B: int = 0) -> _HeapState:
+def _init_state(cfg: GrowParams, F: int, G0, H0, B: int = 0,
+                ptab_rows: int = 1) -> _HeapState:
     max_nodes = cfg.max_nodes
     p = cfg.split
     z = lambda dt: jnp.zeros((max_nodes,), dt)  # noqa: E731
@@ -127,7 +134,9 @@ def _init_state(cfg: GrowParams, F: int, G0, H0, B: int = 0) -> _HeapState:
         loss_chg=z(jnp.float32),
         lo_b=jnp.full((nb,), -_INF), up_b=jnp.full((nb,), _INF),
         used=jnp.zeros((nu, F), bool),
-        ptab=jnp.zeros((1, 5 + B if cat else 4), jnp.float32),
+        # ptab_rows > 1: the depth-scanned driver carries a FIXED-width
+        # decision table (the deepest level's width) through lax.scan
+        ptab=jnp.zeros((ptab_rows, 5 + B if cat else 4), jnp.float32),
         cat_set=jnp.zeros((max_nodes if cat else 1, B if cat else 1), bool),
     )
 
@@ -139,17 +148,30 @@ def _level_update(
     tree_mask: jax.Array,  # [F] colsample_bytree mask
     k_level: jax.Array,  # PRNG key for bylevel/bynode draws
     cfg: GrowParams,
-    d: int,
+    d,  # python int (unrolled/paged) or traced scalar (depth scan)
+    Kw: Optional[int] = None,
 ) -> _HeapState:
     """Evaluate level ``d``'s splits from its histogram and write the heap
     arrays + the next partition table. Shared by the in-core single-program
-    grower and the external-memory paged driver."""
+    grower, the depth-scanned driver and the external-memory paged driver.
+
+    ``Kw`` is the FIXED node width of the depth-scanned driver (the
+    deepest level's ``2^(max_depth-1)``); ``d`` is then a traced scan
+    counter and the heap offset is computed in-program. Lanes beyond a
+    shallow level's true width carry zero G/H (no row occupies them), so
+    ``can_split`` masks them out and their (transient) heap writes are
+    overwritten by the deeper levels' own slot writes before anything
+    reads them — the padding is self-masking."""
     F = tree_mask.shape[0]
     B = cut_values.shape[1]
     p = cfg.split
     max_nodes = cfg.max_nodes
-    K = 1 << d
-    off = K - 1
+    if Kw is None:
+        K = 1 << d
+        off = K - 1
+    else:
+        K = Kw
+        off = jnp.left_shift(jnp.int32(1), d) - 1
     mono_j, gmask = _constraint_consts(cfg, F)
 
     Gtot = jax.lax.dynamic_slice_in_dim(st.node_g, off, K)
@@ -332,7 +354,11 @@ def grow_tree_fused(
                                      onehot)
 
 
-@guard_jit(name="grow_tree_fused", static_argnames=("cfg",))
+# hess is donated (the grow program has exactly one [n]-shaped output — the
+# prediction-cache delta — so exactly one [n] input buffer can be reused in
+# place; donating grad too just trips XLA's "not usable" warning)
+@guard_jit(name="grow_tree_fused", static_argnames=("cfg",),
+           donate_argnames=("hess",))
 def _grow_tree_fused_impl(
     bins: jax.Array,
     grad: jax.Array,
@@ -345,13 +371,18 @@ def _grow_tree_fused_impl(
     feature_weights: Optional[jax.Array] = None,
     onehot: Optional[jax.Array] = None,
 ) -> GrownTree:
-    bins = bins.astype(jnp.int32)  # transient in-program widening
+    pallas = _pallas_flag(cfg)
+    if pallas:
+        # transient in-program widening for the Mosaic kernels; the XLA
+        # and native paths read the NARROW storage dtype directly (the
+        # int8-packing half of the ISSUE 13 tentpole: no 4x int32 copy of
+        # the bin matrix on the CPU path)
+        bins = bins.astype(jnp.int32)
     n, F = bins.shape
     B = cut_values.shape[1]
     p = cfg.split
     max_depth = cfg.max_depth
     max_nodes = cfg.max_nodes
-    pallas = _pallas_flag(cfg)
 
     k_sub, k_ctree, k_level = jax.random.split(key, 3)
     if cfg.axis_name is not None:
@@ -376,21 +407,62 @@ def _grow_tree_fused_impl(
     st = _init_state(cfg, F, G0, H0, B)
 
     pos = jnp.zeros((n, 1), jnp.int32)
-    for d in range(max_depth):
-        K = 1 << d
-        Kp = K >> 1  # previous level width (0 at the root)
-        pos, histC = fused_level(
-            bins, pos, gh, st.ptab, K=K, Kp=Kp, B=B, d=d, pallas=pallas,
-            onehot=onehot, axis_name=cfg.axis_name,
-        )  # histC: [F, 2K, B], missing excluded
-        if cfg.axis_name is not None:
-            histC = jax.lax.psum(histC, cfg.axis_name)
-        st = _level_update(st, histC, cut_values, tree_mask, k_level, cfg, d)
+    if _use_depth_scan(cfg, pallas, max_depth):
+        # fused depth scan (ISSUE 13 tentpole): the per-level bodies
+        # collapse into ONE lax.scan over the depth counter at the
+        # deepest level's fixed node width — a depth-6 tree stages one
+        # level program instead of six specialized ones (compile time and
+        # program size drop ~proportionally), and the scan carry gives
+        # the per-level node-state tensors in-place reuse for free. The
+        # pallas path keeps the unrolled loop: its Mosaic kernels
+        # specialize the matmul M-dim to the level's true width (the
+        # whole point of unrolling on TPU) and bake heap offsets into the
+        # kernel grid.
+        from .hist_kernel import fused_level_scanned, use_native_hist
+
+        Km = 1 << (max_depth - 1)
+        st = _init_state(cfg, F, G0, H0, B, ptab_rows=Km)
+        native = (cfg.axis_name is None
+                  and bins.dtype in (jnp.uint8, jnp.uint16)
+                  and use_native_hist())
+
+        def _level_body(carry, d):
+            st, pos = carry
+            prev_off = jnp.left_shift(
+                jnp.int32(1), jnp.maximum(d - 1, 0)) - 1  # 0 at the root
+            off = jnp.left_shift(jnp.int32(1), d) - 1
+            pos, histC = fused_level_scanned(
+                bins, pos, gh, st.ptab, prev_off, off, K=Km, B=B,
+                native=native)
+            if cfg.axis_name is not None:
+                from .. import collective
+
+                histC = collective.psum(histC, cfg.axis_name)
+            st = _level_update(st, histC, cut_values, tree_mask, k_level,
+                               cfg, d, Kw=Km)
+            return (st, pos), None
+
+        (st, pos), _ = jax.lax.scan(
+            _level_body, (st, pos),
+            jnp.arange(max_depth, dtype=jnp.int32))
+    else:
+        for d in range(max_depth):
+            K = 1 << d
+            Kp = K >> 1  # previous level width (0 at the root)
+            pos, histC = fused_level(
+                bins, pos, gh, st.ptab, K=K, Kp=Kp, B=B, d=d, pallas=pallas,
+                onehot=onehot, axis_name=cfg.axis_name,
+            )  # histC: [F, 2K, B], missing excluded
+            if cfg.axis_name is not None:
+                histC = jax.lax.psum(histC, cfg.axis_name)
+            st = _level_update(st, histC, cut_values, tree_mask, k_level,
+                               cfg, d)
 
     # ---- route rows through the last level's splits to their leaves ----
     if max_depth > 0:
-        pos = partition_apply_xla(
-            bins, pos, st.ptab, Kp=1 << (max_depth - 1), B=B, d=max_depth
+        pos = partition_apply(
+            bins, pos, st.ptab, Kp=1 << (max_depth - 1), B=B, d=max_depth,
+            axis_name=cfg.axis_name,
         )
 
     keep, leaf_value = _finalize(st, eta, gamma, cfg)
@@ -404,6 +476,22 @@ def _grow_tree_fused_impl(
         loss_chg=st.loss_chg, leaf_value=leaf_value, delta=delta,
         cat_set=st.cat_set,
     )
+
+
+def _use_depth_scan(cfg: GrowParams, pallas: bool, max_depth: int) -> bool:
+    """Whether the level loop runs as one lax.scan (the fused depth scan)
+    instead of unrolled per-level bodies. Off for: the pallas path (Mosaic
+    kernels specialize per level width by design), categorical trees (the
+    widened decision table is level-shaped), meshes (the unrolled loop is
+    the proven shard_map path) and ``XGBTPU_DEPTH_SCAN=0`` (escape
+    hatch)."""
+    import os
+
+    if pallas or cfg.has_categorical or max_depth < 1:
+        return False
+    if cfg.axis_name is not None:
+        return False
+    return os.environ.get("XGBTPU_DEPTH_SCAN", "1") != "0"
 
 
 def _pallas_flag(cfg: GrowParams) -> bool:
@@ -424,8 +512,11 @@ def _pallas_flag(cfg: GrowParams) -> bool:
 # Retrace-guarded: these recompile per level width by design (K is static),
 # so their budget is the level count, not 1 — the guard makes any EXTRA
 # recompile (e.g. a non-static scalar sneaking in) visible and budgetable.
+# The heap state is DONATED: the per-level node-state tensors are updated
+# in place across the level loop instead of re-allocated (ISSUE 13).
 _level_update_jit = guard_jit(_level_update, name="level_update",
-                              static_argnames=("cfg", "d"))
+                              static_argnames=("cfg", "d"),
+                              donate_argnames=("st",))
 _finalize_jit = guard_jit(_finalize, name="finalize",
                           static_argnames=("cfg",))
 
@@ -433,7 +524,7 @@ _finalize_jit = guard_jit(_finalize, name="finalize",
 @guard_jit(name="page_delta", static_argnames=("Kp", "B", "d", "pallas",
                                                "pad_nodes"))
 def _page_delta(bins, pos, ptab, leaf_value, *, Kp, B, d, pallas, pad_nodes):
-    pos = partition_apply_xla(bins, pos, ptab, Kp=Kp, B=B, d=d)
+    pos = partition_apply(bins, pos, ptab, Kp=Kp, B=B, d=d)
     return leaf_delta(pos, leaf_value, pad_nodes, pallas=pallas)
 
 
@@ -526,7 +617,8 @@ def _grow_tree_fused_paged(
         if arr.shape[0] != pr_pad:
             pad = np.full((pr_pad - arr.shape[0], F), missing_bin, arr.dtype)
             arr = np.concatenate([arr, pad])
-        return jnp.asarray(arr.astype(np.int32))
+        # narrow dtype preserved off-TPU (native/XLA paths read it as-is)
+        return jnp.asarray(arr.astype(np.int32) if pallas else arr)
 
     for d in range(cfg.max_depth):
         K = 1 << d

@@ -33,6 +33,7 @@ non-TPU backends (CPU tests, virtual-device dryruns) via segment_sum.
 from __future__ import annotations
 
 import functools
+import threading
 from typing import Optional, Tuple
 
 import jax
@@ -43,9 +44,10 @@ from ..analysis.retrace import guard_jit
 from ..resilience.degrade import OneShot
 
 __all__ = [
-    "fused_level", "fused_level_xla", "partition_apply_xla", "leaf_delta",
-    "TR", "use_pallas", "build_onehot", "hoist_budget_bytes", "can_hoist",
-    "hoist_plan", "device_free_bytes",
+    "fused_level", "fused_level_xla", "fused_level_native",
+    "partition_apply", "partition_apply_xla", "leaf_delta",
+    "TR", "use_pallas", "use_native_hist", "build_onehot",
+    "hoist_budget_bytes", "can_hoist", "hoist_plan", "device_free_bytes",
 ]
 
 TR = 1024  # rows per kernel grid step
@@ -66,6 +68,115 @@ _MAX_KERNEL_FEATURES = 512
 def use_pallas() -> bool:
     """Whether the fused TPU kernel path is usable on the default backend."""
     return jax.default_backend() == "tpu"
+
+
+# ---------------------------------------------------------------------------
+# Native CPU histogram: XLA:CPU lowers segment_sum to a serialized scatter
+# measured at ~68ns per (row, feature) update — table size, update width and
+# index order do not move it, so at the bench shape the histogram IS the
+# round (6 levels x ~345ms of a ~2s round). hist_build.cpp does the same
+# f32 additions in the same row order in ~7ms (the reference's GHistBuilder
+# tier, hist_util.h:323), reading bins in their NARROW storage dtype
+# (uint8/uint16 — no widened int32 copy of the bin matrix anywhere), and is
+# bit-identical to a standalone segment_sum. It is wired in as an XLA FFI
+# custom call (NOT jax.pure_callback: on a single-core CPU client the
+# callback machinery's async operand copies queue behind the very program
+# being executed — np.asarray deadlocks, raw buffer reads race the copy;
+# the FFI handler runs synchronously inside the thunk with materialized
+# buffers), so the host round loop stays non-blocking and the scan/pipeline
+# structure above it is unchanged. XGBTPU_NATIVE_HIST=0 kills it.
+# ---------------------------------------------------------------------------
+
+_ENV_NATIVE_HIST = "XGBTPU_NATIVE_HIST"
+
+_ffi_lock = threading.Lock()
+_ffi_state = {"registered": None}  # None = not tried, True/False = result
+
+
+def _ensure_ffi() -> bool:
+    """Build/load the native library and register its FFI handlers with
+    XLA (once per process). False when the toolchain, jaxlib FFI headers
+    or the jax.extend.ffi API are unavailable."""
+    with _ffi_lock:
+        if _ffi_state["registered"] is not None:
+            return _ffi_state["registered"]
+        _ffi_state["registered"] = False
+        try:
+            from jax.extend import ffi as jffi
+
+            from ..native import get_hist_lib
+
+            lib = get_hist_lib()
+            if lib is None:
+                return False
+            jffi.register_ffi_target(
+                "xgbtpu_hb_level", jffi.pycapsule(lib.XgbtpuHbLevel),
+                platform="cpu")
+            jffi.register_ffi_target(
+                "xgbtpu_hb_partition", jffi.pycapsule(lib.XgbtpuHbPartition),
+                platform="cpu")
+            _ffi_state["registered"] = True
+        except Exception:
+            return False
+        return True
+
+
+def use_native_hist() -> bool:
+    """Whether the native (FFI custom call) histogram path is usable:
+    CPU backend, kernel tests not forcing interpret mode, kill switch not
+    set, and the on-demand library builds/loads/registers."""
+    import os
+
+    if os.environ.get(_ENV_NATIVE_HIST) == "0":
+        return False
+    if _INTERPRET or jax.default_backend() != "cpu":
+        return False
+    return _ensure_ffi()
+
+
+def fused_level_native(bins, pos, gh, ptab, *, K, Kp, B, d=None,
+                       prev_offset=None, offset=None):
+    """Same contract as ``fused_level_xla`` — (new pos [n,1] i32, hist
+    [F, 2K, B] f32, missing excluded) — via the native FFI kernel. Only
+    valid for numerical decision tables (W == 4) on narrow-int bins. The
+    heap offsets derive from static ``d``, or arrive as traced scalars
+    from the depth-scanned driver (one call site for the kernel ABI)."""
+    from jax.extend import ffi as jffi
+
+    n, F = bins.shape
+    if prev_offset is None:
+        prev_offset = jnp.int32((1 << (d - 1)) - 1 if d > 0 else 0)
+        offset = jnp.int32((1 << d) - 1)
+    return jffi.ffi_call(
+        "xgbtpu_hb_level",
+        (jax.ShapeDtypeStruct((n, 1), jnp.int32),
+         jax.ShapeDtypeStruct((F, 2 * K, B), jnp.float32)),
+        bins, pos, gh, ptab,
+        prev_offset.astype(jnp.int32), offset.astype(jnp.int32),
+        K=K, Kp=Kp, B=B)
+
+
+def _native_ok(bins, ptab, axis_name) -> bool:
+    """Trace-time gate for the native FFI path."""
+    return (axis_name is None and ptab.shape[-1] == 4
+            and bins.dtype in (jnp.uint8, jnp.uint16)
+            and use_native_hist())
+
+
+def partition_apply(bins, pos, ptab, *, Kp: int, B: int, d: int,
+                    axis_name=None):
+    """Route rows through level ``d-1``'s decisions: the native FFI kernel
+    on the CPU path, XLA everywhere else (identical integer decisions)."""
+    if _native_ok(bins, ptab, axis_name):
+        from jax.extend import ffi as jffi
+
+        n, F = bins.shape
+        prev_offset = (1 << (d - 1)) - 1 if d > 0 else 0
+        return jffi.ffi_call(
+            "xgbtpu_hb_partition",
+            jax.ShapeDtypeStruct((n, 1), jnp.int32),
+            bins, pos, ptab, Kp=Kp, B=B, prev_offset=prev_offset)
+    return partition_apply_xla(bins, pos, ptab, Kp=Kp, B=B, d=d)
 
 
 # ---------------------------------------------------------------------------
@@ -551,11 +662,15 @@ def _hoisted_level_pallas(bins, onehot, pos, gh, ptab, *, K, Kp, B, d,
     return pos_new, hist
 
 
-def partition_apply_xla(bins, pos, ptab, *, Kp: int, B: int, d: int):
+def partition_apply_xla(bins, pos, ptab, *, Kp: int, B: int, d: int,
+                        prev_offset=None):
     """Route rows through level ``d-1``'s decisions (XLA, gather-free where
     it matters: the per-node table lookup is a one-hot matmul). Handles
-    both table layouts — see ``_partition_tile``."""
-    prev_offset = (1 << (d - 1)) - 1 if d > 0 else 0
+    both table layouts — see ``_partition_tile``. ``prev_offset`` may be a
+    TRACED scalar (the depth-scanned grow passes ``2^(d-1) - 1`` computed
+    inside the scan body); when None it is derived statically from ``d``."""
+    if prev_offset is None:
+        prev_offset = (1 << (d - 1)) - 1 if d > 0 else 0
     W = ptab.shape[1]
     lp = pos[:, 0] - prev_offset  # [n]
     ohp = jax.nn.one_hot(jnp.where((lp >= 0) & (lp < Kp), lp, Kp),
@@ -600,6 +715,32 @@ def fused_level_xla(bins, pos, gh, ptab, *, K, Kp, B, d):
 
     hist = blocked_histogram(bins, gh, seg, K, MB)  # [K, F, MB, 2]
     # -> kernel layout [F, 2K, B] (drop the missing bin: recovered by caller)
+    hg = jnp.transpose(hist[:, :, :B, 0], (1, 0, 2))  # [F, K, B]
+    hh = jnp.transpose(hist[:, :, :B, 1], (1, 0, 2))
+    return pos, jnp.concatenate([hg, hh], axis=1)  # [F, 2K, B]
+
+
+def fused_level_scanned(bins, pos, gh, ptab, prev_offset, offset, *,
+                        K: int, B: int, native: bool):
+    """One FIXED-WIDTH level step for the depth-scanned grow: partition
+    rows through the previous level's decisions, then histogram, with the
+    heap offsets as traced scalars and the node width pinned to ``K`` (the
+    deepest level's ``2^(max_depth-1)``) at every iteration. Lanes beyond
+    a shallow level's real width are self-masking: no row occupies them
+    (histogram zero) and their heap stats are zero, so ``eval_splits``
+    can never split them. Same output contract as ``fused_level_xla``."""
+    if native:
+        return fused_level_native(bins, pos, gh, ptab, K=K, Kp=K, B=B,
+                                  prev_offset=prev_offset, offset=offset)
+    pos = partition_apply_xla(bins, pos, ptab, Kp=K, B=B, d=-1,
+                              prev_offset=prev_offset)
+    local = pos[:, 0] - offset
+    n, F = bins.shape
+    seg = jnp.where((local >= 0) & (local < K), local, -1)
+    MB = B + 1
+    from .grow import blocked_histogram
+
+    hist = blocked_histogram(bins, gh, seg, K, MB)  # [K, F, MB, 2]
     hg = jnp.transpose(hist[:, :, :B, 0], (1, 0, 2))  # [F, K, B]
     hh = jnp.transpose(hist[:, :, :B, 1], (1, 0, 2))
     return pos, jnp.concatenate([hg, hh], axis=1)  # [F, 2K, B]
@@ -660,6 +801,8 @@ def fused_level(bins, pos, gh, ptab, *, K, Kp, B, d, pallas: bool,
     if pallas and F <= _MAX_KERNEL_FEATURES and acc_bytes <= _VMEM_ACC_BUDGET:
         return _fused_level_pallas(bins, pos, gh, ptab, K=K, Kp=Kp, B=B,
                                    d=d, vma=vma)
+    if _native_ok(bins, ptab, axis_name):
+        return fused_level_native(bins, pos, gh, ptab, K=K, Kp=Kp, B=B, d=d)
     return fused_level_xla(bins, pos, gh, ptab, K=K, Kp=Kp, B=B, d=d)
 
 
