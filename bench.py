@@ -941,6 +941,17 @@ def _run_configs(args, suffix: str, final: dict) -> None:
         final["pipeline_depth"] = pipeline_depth()
     except Exception:
         pass
+    try:
+        # the routes the run actually took (op -> chosen impl): a perf
+        # delta is only attributable when the trajectory file says which
+        # kernel served each op (ISSUE 14 satellite)
+        from xgboost_tpu import dispatch
+
+        routed = dispatch.last_decisions()
+        if routed:
+            final["dispatch"] = routed
+    except Exception:
+        pass
     _log_partial({"config": f"bin{primary_bin}", "rows": rows,
                   "rounds_done": done, "seconds": round(measured, 3),
                   "auc": None if auc != auc else round(auc, 5),

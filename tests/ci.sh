@@ -35,6 +35,13 @@ assert not missing, f"lint rules no longer firing: {missing}"
 print(f"lint self-check OK: all {len(ALL_RULES)} rules fire")
 EOF
 
+echo "=== tier 0.5: kernel dispatch report (all ops resolve on CPU) ==="
+# the resolved kernel table is a CI artifact: rc != 0 means some op has
+# NO usable implementation on this platform — a broken registry entry
+# fails here before a single test compiles (docs/perf.md, "Choosing a
+# kernel")
+python -m xgboost_tpu dispatch-report
+
 echo "=== tier 1: full suite (8-device virtual mesh, traced) ==="
 TRACE_OUT=$(mktemp /tmp/xgbtpu_ci_trace.XXXXXX.json)
 export XGBTPU_TRACE="$TRACE_OUT"
@@ -539,6 +546,33 @@ finally:
     if proc2.poll() is None:
         proc2.kill()
 
+EOF
+
+# ---- dispatch degrade routing (ISSUE 14): a seeded pallas fault must
+# surface as a degraded predict_walk decision in the exposition ----
+python - <<'EOF'
+from xgboost_tpu import dispatch
+from xgboost_tpu.observability import REGISTRY
+from xgboost_tpu.resilience import chaos, degrade
+
+with chaos.configure("serving_device_probe:resource:1"):
+    try:
+        chaos.hit("serving_device_probe")
+    except chaos.ChaosError as e:
+        degrade.capability("pallas_predict").failure(e, key=("ci-shape",))
+assert degrade.worst("pallas_predict") != degrade.HEALTHY
+
+# the device-platform table routes to the native walker with the degrade
+# attribution — the lookup that replaced serving_context(force_native=)
+dec = dispatch.resolve("predict_walk", dispatch.Ctx(
+    platform="tpu", has_cats=False, heap_layout=True))
+assert (dec.impl, dec.reason) == ("native", "degraded"), dec
+exp = REGISTRY.exposition()
+needle = ('dispatch_decisions_total{impl="native",op="predict_walk",'
+          'reason="degraded"}')
+assert needle in exp, exp[-2000:]
+print("dispatch degrade routing OK: seeded pallas fault ->",
+      f"{dec.impl} ({dec.reason}), decision series in exposition")
 EOF
 
 echo "=== tier 1.8: fleet lane (2 replicas + router, SIGTERM mid-traffic) ==="

@@ -45,7 +45,13 @@ def _reset_resilience_state():
     resilience layer replaced per-object latches); tests that degrade a
     capability or arm a chaos plan must not poison later tests."""
     yield
+    from xgboost_tpu import dispatch
     from xgboost_tpu.resilience import chaos, degrade
 
     chaos.reset()
     degrade.reset()
+    # resolved-route cache and deprecation warn-once state are process-
+    # wide too; a test that pins/degrades a route must not leak its
+    # decisions (the cache key includes env + capability state, but the
+    # route-change history and last-decision map are cumulative)
+    dispatch.reset()

@@ -49,6 +49,16 @@ def stray_collective(x):
     return jax.lax.psum(x, "data")  # RS501: collective outside collective.py
 
 
+def selects_backend_directly():
+    import os
+
+    # CC405: backend kill-switch env read outside dispatch/ (the legacy
+    # envs map to dispatch pins in one shim; call sites resolve the op)
+    if os.environ.get("XGBTPU_NATIVE_HIST") == "0":
+        return "xla"
+    return "native"
+
+
 def swallowed_dispatch_failure(entry, X):
     try:
         return entry.predict(X)

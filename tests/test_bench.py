@@ -58,10 +58,16 @@ def test_bench_produces_json_lines():
     # where each run spends a round
     assert {"metric", "value", "unit", "vs_baseline"} <= set(rec)
     assert set(rec) <= {"metric", "value", "unit", "vs_baseline",
-                        "stages", "pipeline_depth"}
+                        "stages", "pipeline_depth", "dispatch"}
     assert rec["pipeline_depth"] >= 0
     assert rec["stages"] and all(v > 0 for v in rec["stages"].values())
     assert "grow" in rec["stages"], rec["stages"]
+    # ISSUE 14 satellite: the line also carries the routing map (op ->
+    # chosen impl) so a perf delta is attributable to the kernel that
+    # actually served it
+    assert rec["dispatch"].get("level_hist") in ("native", "xla", "pallas")
+    assert rec["dispatch"].get("depth_scan") in ("scanned", "unrolled")
+    assert all(isinstance(v, str) for v in rec["dispatch"].values())
     assert rec["unit"] == "s" and rec["value"] > 0
     assert rec["metric"].startswith("train_time_12kx50_4r_depth6")
     # off-baseline workload (12k != 1M rows): ratio must not pose as speedup

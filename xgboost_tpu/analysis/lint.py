@@ -44,7 +44,10 @@ silently or — under ``jax_enable_x64`` — doubles every buffer):
 - CC401: a module-level mutable container (cache / registry / latch dict)
   mutated inside a function with no enclosing lock ``with``;
 - CC402: a ``global`` scalar rebound inside a function with no enclosing
-  lock (one-shot latches racing their check-then-set).
+  lock (one-shot latches racing their check-then-set);
+- CC405: direct kernel-backend selection (``use_pallas``-style probe
+  calls, ``XGBTPU_NATIVE_*``/``XGBTPU_DEPTH_SCAN`` env reads) outside
+  ``dispatch/`` — backend choice belongs to the dispatch registry.
 
 Findings carry ``file:line`` + rule id + the enclosing symbol; the
 baseline file (``baseline.py``) suppresses on (rule, file, symbol) so
@@ -73,6 +76,7 @@ ALL_RULES = {
     "CC401": "module-level mutable state mutated outside a lock",
     "CC402": "global rebound outside a lock",
     "CC403": "module-level fallback latch outside resilience/degrade.py",
+    "CC405": "direct kernel-backend selection outside dispatch/",
     "RS501": "direct collective call site outside collective.py",
     "RS502": "bare broad except swallow on the serving dispatch path",
 }
@@ -142,6 +146,18 @@ _RS502_CLASSIFIERS = {"classify", "record_failure", "record_serving_fault"}
 _CC403_WORDS = ("broken", "failed", "blocked", "latch", "disabled",
                      "blacklist", "poisoned")
 _CC403_EXEMPT = "resilience/degrade.py"
+
+# CC405: kernel-backend choice (pallas / XLA / native) belongs to the
+# dispatch registry (``dispatch/``) — one table integrating pins, degrade
+# state and platform preference. A `use_pallas()`-style branch or a
+# direct read of a backend kill-switch env outside dispatch/ is a fresh
+# scattered route the registry exists to delete (finishes the job CC403
+# started for fallback latches). Blessed in-kernel residue — the platform
+# probes that FEED the dispatch ctx — lives in the baseline, justified.
+_CC405_ENV_PREFIX = "XGBTPU_NATIVE_"
+_CC405_ENV_EXACT = ("XGBTPU_DEPTH_SCAN", "XGBTPU_DISPATCH")
+_CC405_SELECTORS = ("use_pallas", "use_native_hist")
+_CC405_EXEMPT_DIR = "dispatch"
 
 # attribute (or bare imported) names that stage/trace their function args
 _TRACE_ENTRIES = {
@@ -1021,6 +1037,66 @@ def _pass_concurrency(project: _Project) -> List[Finding]:
     return out
 
 
+def _cc405_env_key(node: ast.AST) -> Optional[str]:
+    """The constant env-var name read by ``os.environ.get(K)`` /
+    ``os.getenv(K)`` / ``environ.get(K)`` / ``os.environ[K]``, or None."""
+    if isinstance(node, ast.Call):
+        chain = _attr_chain(node.func)
+        if not chain:
+            return None
+        env_get = (chain[-1] == "get" and len(chain) >= 2
+                   and chain[-2] == "environ") or chain[-1] == "getenv"
+        if env_get and node.args \
+                and isinstance(node.args[0], ast.Constant) \
+                and isinstance(node.args[0].value, str):
+            return node.args[0].value
+    if isinstance(node, ast.Subscript):
+        chain = _attr_chain(node.value)
+        if chain and chain[-1] == "environ" \
+                and isinstance(node.slice, ast.Constant) \
+                and isinstance(node.slice.value, str):
+            return node.slice.value
+    return None
+
+
+def _pass_dispatch_fences(project: _Project) -> List[Finding]:
+    """CC405: backend kill-switch env reads and ``use_pallas``-style
+    selector calls outside ``dispatch/``. Both fire on the concrete
+    artifact (the env key / the probe name), not on vague if/else shapes,
+    so the rule stays precise; the justified probe residue that feeds the
+    dispatch ctx is baselined, never code-exempted."""
+    out: List[Finding] = []
+    for mod in project.modules:
+        if mod.in_package and mod.in_scope((_CC405_EXEMPT_DIR,)):
+            continue
+        symbols = _symbol_index(mod)
+        for node in ast.walk(mod.tree):
+            key = _cc405_env_key(node)
+            if key is not None and (key.startswith(_CC405_ENV_PREFIX)
+                                    or key in _CC405_ENV_EXACT):
+                out.append(Finding(
+                    "CC405", mod.relpath, node.lineno,
+                    symbols.get(node.lineno, "<module>"),
+                    f"backend kill-switch env {key!r} read outside "
+                    f"dispatch/: the legacy envs map to dispatch pins in "
+                    f"ONE shim (dispatch/core.py LEGACY_ENVS) — resolve "
+                    f"the op through the registry instead (docs/perf.md, "
+                    f"'Choosing a kernel')"))
+                continue
+            if isinstance(node, ast.Call):
+                chain = _attr_chain(node.func)
+                if chain and chain[-1] in _CC405_SELECTORS:
+                    out.append(Finding(
+                        "CC405", mod.relpath, node.lineno,
+                        symbols.get(node.lineno, "<module>"),
+                        f"direct backend probe '{chain[-1]}()' outside "
+                        f"dispatch/: pick the impl via dispatch.resolve "
+                        f"(probes that only FEED the dispatch ctx are "
+                        f"blessed residue — baseline them with a "
+                        f"justification)"))
+    return out
+
+
 def _pass_collectives(project: _Project) -> List[Finding]:
     """RS501: direct ``lax.psum``/``all_gather``/``process_allgather``/...
     call sites anywhere but ``collective.py`` (the guarded entry point).
@@ -1191,6 +1267,7 @@ def lint_paths(paths: Optional[Sequence[str]] = None,
     findings += _pass_retrace_hygiene(project)
     findings += _pass_dtype(project)
     findings += _pass_concurrency(project)
+    findings += _pass_dispatch_fences(project)
     findings += _pass_collectives(project)
     findings += _pass_round_loop_sync(project)
     findings += _pass_serving_excepts(project)

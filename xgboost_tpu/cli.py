@@ -4,6 +4,7 @@ Reference: ``src/cli_main.cc`` (CLITask :30-35, CLIParam :37) + the
 key=value config parser (``src/common/config.h``). Usage:
 
     python -m xgboost_tpu <config> [key=value ...]
+    python -m xgboost_tpu dispatch-report
     python -m xgboost_tpu trace-report <trace-file|glob> ... [--top N]
     python -m xgboost_tpu obs-report <run_dir> ... [--top-rounds N]
     python -m xgboost_tpu serve-report <run_dir> ... [--top N]
@@ -35,6 +36,11 @@ per-tenant rollup (docs/serving.md "Scaling out"). ``serve-fleet`` runs
 that fleet: N supervised crash-only ``serve`` replicas sharing one
 manifest behind the consistent-hash routing front
 (``serving/fleet/``).
+``dispatch-report`` prints the fully-resolved kernel dispatch table
+(op × impl × reason: preferred/pinned/degraded/unavailable) for the
+current platform, including any ``XGBTPU_DISPATCH`` pins and legacy
+kill-switch envs in effect (docs/perf.md, "Choosing a kernel"); exit 1
+when any op has no usable implementation.
 ``lint`` runs the static-analysis gate (trace-safety / retrace / dtype /
 concurrency passes, ``docs/static_analysis.md``):
 
@@ -117,6 +123,10 @@ def cli_main(argv: List[str]) -> int:
         from .analysis.cli import main as lint_main
 
         return lint_main(argv[1:])
+    if argv[0] == "dispatch-report":
+        from .dispatch.report import main as dispatch_report_main
+
+        return dispatch_report_main(argv[1:])
     if argv[0] == "checkpoint-inspect":
         return checkpoint_inspect_main(argv[1:])
     if argv[0] == "deliver":

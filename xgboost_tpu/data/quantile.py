@@ -329,7 +329,11 @@ class BinnedMatrix:
         share one VMEM model). ``Fh < F`` is the partial hoist: the kernel
         streams these features and constructs the rest in-kernel. Cached
         once built: the expansion is training-invariant, so every tree of
-        every round streams the same resident array."""
+        every round streams the same resident array. The build itself
+        routes through the kernel dispatch registry
+        (``dispatch.resolve("onehot_build", ...)`` inside
+        ``build_onehot`` — docs/perf.md, "Choosing a kernel"), so pins
+        and the ``onehot_build`` capability state apply there too."""
         from ..tree.hist_kernel import build_onehot, hoist_plan
 
         bins, n_pad = self.fused_bins()

@@ -1,0 +1,34 @@
+"""Backend-neutral kernel dispatch: one registry routing every op.
+
+Public surface (see ``core.py`` for the design notes):
+
+- :func:`resolve` / :class:`Ctx` / :class:`Decision` — the lookup.
+- :func:`register` — add an impl (a GPU backend is a table entry).
+- :func:`pinned_off` / :func:`degraded` — compat/admission reads.
+- :func:`explain` / :func:`last_decisions` / :func:`table_snapshot` —
+  the report CLI, BENCH sidecar and flight-black-box surfaces.
+"""
+
+from .core import (  # noqa: F401
+    Ctx,
+    Decision,
+    DispatchError,
+    KernelImpl,
+    LEGACY_ENVS,
+    degraded,
+    explain,
+    last_decisions,
+    op_names,
+    pinned_off,
+    register,
+    reset,
+    resolve,
+    set_report_ctx,
+    table_snapshot,
+)
+
+__all__ = [
+    "Ctx", "Decision", "DispatchError", "KernelImpl", "LEGACY_ENVS",
+    "degraded", "explain", "last_decisions", "op_names", "pinned_off",
+    "register", "reset", "resolve", "set_report_ctx", "table_snapshot",
+]

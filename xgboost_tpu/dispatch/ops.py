@@ -1,0 +1,170 @@
+"""The default kernel-op table: every backend a registry entry.
+
+Each op's impls, applicability predicates and per-platform preference
+live HERE — a new backend (a GPU tier, a second native kernel) is a
+``register`` call, not a rewrite of the call sites. Predicates read only
+the :class:`~xgboost_tpu.dispatch.core.Ctx` the call site passed (shape,
+dtype, platform flags) plus the owning module's probe helpers; they are
+imported lazily so importing the dispatch layer never drags in jax or
+builds a native library.
+
+Op reference (see docs/perf.md, "Choosing a kernel"):
+
+====================  =========================================  =============
+op                    implementations (preference order)         capability
+====================  =========================================  =============
+``level_hist``        pallas > native (CPU) > xla                —
+``level_partition``   native (CPU) > xla                         —
+``level_update``      xla (single impl: shared split eval)       —
+``depth_scan``        scanned > unrolled                         —
+``onehot_build``      pallas > xla                               —
+``leaf_delta``        pallas > xla                               —
+``predict_walk``      TPU: pallas > xla > native;                pallas_predict
+                      CPU: native > xla                          (device impls)
+====================  =========================================  =============
+"""
+
+from __future__ import annotations
+
+from .core import Ctx, register, set_report_ctx
+
+_NARROW_BINS = ("uint8", "uint16")
+
+
+def _platform() -> str:
+    import jax
+
+    return jax.default_backend()
+
+
+def _native_level_applicable(ctx: Ctx) -> bool:
+    """The FFI level kernel's trace-time envelope: CPU backend, in-process
+    (no mesh axis), numerical 4-wide decision tables, narrow-int bins,
+    and not the interpret-mode kernel tests."""
+    return (ctx.get("platform") == "cpu"
+            and not ctx.get("interpret", False)
+            and not ctx.get("sharded", False)
+            and ctx.get("table_width", 4) == 4
+            and ctx.get("bins_dtype") in _NARROW_BINS)
+
+
+def _native_level_available(ctx: Ctx) -> bool:
+    from ..tree import hist_kernel
+
+    return hist_kernel._ensure_ffi()
+
+
+def _pallas_level_applicable(ctx: Ctx) -> bool:
+    from ..tree import hist_kernel
+
+    return bool(ctx.get("pallas")) and hist_kernel.pallas_level_fits(
+        int(ctx.get("rows", 0)), int(ctx.get("features", 0)),
+        int(ctx.get("nodes", 1)), int(ctx.get("bins", 0)),
+        int(ctx.get("onehot_width", 0)))
+
+
+register("level_hist", "pallas", pref=(("*", 0),),
+         applicable=_pallas_level_applicable)
+register("level_hist", "native", pref=(("*", 1),),
+         applicable=_native_level_applicable,
+         available=_native_level_available)
+register("level_hist", "xla", pref=(("*", 2),))
+set_report_ctx("level_hist", lambda: Ctx(
+    platform=_platform(), pallas=_platform() == "tpu", interpret=False,
+    rows=8192, features=50, nodes=32, bins=64, table_width=4,
+    bins_dtype="uint8", sharded=False, onehot_width=0))
+
+
+register("level_partition", "native", pref=(("*", 0),),
+         applicable=_native_level_applicable,
+         available=_native_level_available)
+register("level_partition", "xla", pref=(("*", 1),))
+set_report_ctx("level_partition", lambda: Ctx(
+    platform=_platform(), interpret=False, table_width=4,
+    bins_dtype="uint8", sharded=False))
+
+
+# split evaluation / heap writes are one shared pure-XLA body on every
+# backend (tree/grow_fused.py:_level_update) — registered so the table is
+# complete and a future backend-specific evaluator is a row, not a branch
+register("level_update", "xla", pref=(("*", 0),))
+set_report_ctx("level_update", lambda: Ctx(platform=_platform()))
+
+
+def _scanned_applicable(ctx: Ctx) -> bool:
+    """The fused depth scan runs where its fixed-width trick is sound:
+    off the pallas path (Mosaic kernels specialize per level width by
+    design), no categorical tables (level-shaped widening), in-process
+    (the unrolled loop is the proven shard_map path), depth >= 1."""
+    return (not ctx.get("pallas", False)
+            and not ctx.get("has_cats", False)
+            and not ctx.get("sharded", False)
+            and int(ctx.get("depth", 0)) >= 1)
+
+
+register("depth_scan", "scanned", pref=(("*", 0),),
+         applicable=_scanned_applicable)
+register("depth_scan", "unrolled", pref=(("*", 1),))
+set_report_ctx("depth_scan", lambda: Ctx(
+    platform=_platform(), pallas=_platform() == "tpu", has_cats=False,
+    sharded=False, depth=6))
+
+
+def _onehot_pallas_applicable(ctx: Ctx) -> bool:
+    from ..tree import hist_kernel
+
+    return (bool(ctx.get("pallas"))
+            and int(ctx.get("features", 0)) > 0
+            and hist_kernel._build_tr(int(ctx.get("rows", 0)),
+                                      int(ctx.get("features", 0)),
+                                      int(ctx.get("bins", 0))) != 0)
+
+
+register("onehot_build", "pallas", pref=(("*", 0),),
+         applicable=_onehot_pallas_applicable)
+register("onehot_build", "xla", pref=(("*", 1),))
+set_report_ctx("onehot_build", lambda: Ctx(
+    platform=_platform(), pallas=_platform() == "tpu", rows=8192,
+    features=50, bins=64))
+
+
+register("leaf_delta", "pallas", pref=(("*", 0),),
+         applicable=lambda ctx: bool(ctx.get("pallas")))
+register("leaf_delta", "xla", pref=(("*", 1),))
+set_report_ctx("leaf_delta", lambda: Ctx(
+    platform=_platform(), pallas=_platform() == "tpu"))
+
+
+def _walk_native_applicable(ctx: Ctx) -> bool:
+    return not ctx.get("has_cats", False)
+
+
+def _walk_native_available(ctx: Ctx) -> bool:
+    from ..native import serving_lib_available
+
+    return serving_lib_available()
+
+
+def _walk_pallas_applicable(ctx: Ctx) -> bool:
+    return (ctx.get("platform") == "tpu"
+            and bool(ctx.get("heap_layout", False))
+            and not ctx.get("has_cats", False))
+
+
+# Preference: on TPU the device walk (pallas, else the bucketed XLA
+# program) owns the route and the native walker is the degrade fallback;
+# on CPU the native walker leads and XLA backstops categorical forests /
+# missing toolchains. Both device impls carry the ``pallas_predict``
+# capability ON DEVICE PLATFORMS ONLY, so a degraded device path routes
+# to native with reason="degraded" — the lookup that replaced the
+# serving_context(force_native=) thread-local.
+register("predict_walk", "pallas", pref=(("*", 0),),
+         applicable=_walk_pallas_applicable,
+         capability="pallas_predict", cap_platforms=("tpu",))
+register("predict_walk", "xla", pref=(("*", 1),),
+         capability="pallas_predict", cap_platforms=("tpu",))
+register("predict_walk", "native", pref=(("cpu", 0), ("*", 2)),
+         applicable=_walk_native_applicable,
+         available=_walk_native_available)
+set_report_ctx("predict_walk", lambda: Ctx(
+    platform=_platform(), has_cats=False, heap_layout=True))

@@ -1,0 +1,58 @@
+"""``python -m xgboost_tpu dispatch-report`` — the resolved kernel table.
+
+Prints op × impl × status (chosen/pinned-off/degraded/unavailable/
+inapplicable/fallback) for the CURRENT platform, plus the pins in effect
+(explicit ``XGBTPU_DISPATCH`` grammar and any legacy kill-switch envs
+mapped onto it). Exit status 0 when every op resolves, 1 when any op has
+no usable implementation — the CI tier-0.5 gate runs this on CPU so a
+broken table fails before a single test does."""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import List, Optional
+
+from .core import LEGACY_ENVS, DispatchError, explain, op_names, resolve
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(argv or [])
+    if argv and argv[0] in ("-h", "--help"):
+        print(__doc__)
+        return 0
+    import jax
+
+    platform = jax.default_backend()
+    print(f"kernel dispatch table (platform={platform})")
+    spec = os.environ.get("XGBTPU_DISPATCH")
+    legacy = [f"{name}={os.environ.get(name)}"
+              for name, trigger, _ in LEGACY_ENVS
+              if os.environ.get(name) == trigger]
+    if spec:
+        print(f"pins: XGBTPU_DISPATCH={spec!r}")
+    if legacy:
+        print(f"legacy pins (deprecated, see docs/perf.md): "
+              f"{', '.join(legacy)}")
+    if not spec and not legacy:
+        print("pins: none (auto preference order)")
+    print()
+    failures = 0
+    width = max(len(op) for op in op_names())
+    for op in op_names():
+        try:
+            dec = resolve(op)
+            head = f"{op:<{width}}  -> {dec.impl} ({dec.reason})"
+        except DispatchError as e:
+            failures += 1
+            head = f"{op:<{width}}  -> UNRESOLVED: {e}"
+        print(head)
+        for row in explain(op):
+            print(f"{'':<{width}}     {row['impl']:<8} "
+                  f"{row['status']:<12} {row['note']}")
+    if failures:
+        print(f"\n{failures} op(s) do not resolve on {platform}",
+              file=sys.stderr)
+        return 1
+    print(f"\nall {len(op_names())} ops resolve on {platform}")
+    return 0

@@ -265,6 +265,16 @@ def get_serving_lib() -> Optional[ctypes.CDLL]:
         return _sv_lib
 
 
+def serving_lib_available() -> bool:
+    """Availability probe for the kernel dispatch registry
+    (``dispatch/ops.py``, op ``predict_walk`` impl ``native``): whether
+    the SoA forest walker builds/loads on this host. First call pays the
+    on-demand build; afterwards it is a memo read. (The ``level_hist``
+    impl probes through ``tree.hist_kernel._ensure_ffi`` instead — load
+    and XLA target registration are one step there.)"""
+    return get_serving_lib() is not None
+
+
 _HB_SRC = os.path.join(_HERE, "hist_build.cpp")
 _HB_LIB = os.path.join(_HERE, "libhistbuild.so")
 _hb_lib: Optional[ctypes.CDLL] = None

@@ -409,6 +409,15 @@ class FlightRecorder:
             doc["metrics"] = REGISTRY.snapshot()
         except Exception:
             doc["metrics"] = {}
+        try:
+            # the resolved kernel routing table: which impl served each
+            # op when the box was dumped (attributes a perf/fault record
+            # to its route — dispatch/core.py)
+            from .. import dispatch
+
+            doc["dispatch"] = dispatch.table_snapshot()
+        except Exception:
+            doc["dispatch"] = {}
         if not atomic_write_json(path, doc):
             return None
         self._refresh_sidecars()

@@ -79,17 +79,19 @@ def _forest_sig(forest: StackedForest) -> Tuple:
     )
 
 
-def _shared_pallas_route(forest: StackedForest) -> bool:
-    """True when the forest should predict through the shared
-    ``predict_margin`` dispatcher (TPU pallas walk + its blacklist/fallback
-    machinery) instead of a per-entry XLA program. Bucketing still holds:
-    the pallas path jits on the padded shape, so ragged streams reuse its
-    internal caches too."""
-    return (
-        forest.heap_layout
-        and not forest.has_cats
-        and jax.default_backend() == "tpu"
-    )
+def _resolve_walk(forest: StackedForest, exclude=()):
+    """Route this forest's predicts through the kernel dispatch registry
+    (``predict_walk``): native walker / shared pallas dispatcher /
+    bucketed XLA program, with pins, platform preference and the
+    ``pallas_predict`` degrade state integrated in ONE lookup — the
+    replacement for the old thread-local ``force_native`` routing and the
+    per-site ``_native_route_ok`` / ``_shared_pallas_route`` gates."""
+    from .. import dispatch
+
+    return dispatch.resolve("predict_walk", dispatch.Ctx(
+        platform=jax.default_backend(),
+        has_cats=bool(forest.has_cats),
+        heap_layout=bool(forest.heap_layout)), exclude=exclude)
 
 
 def _build_program(n_groups: int, max_depth: int, has_cats: bool,
@@ -273,37 +275,37 @@ def _device_tree_weights(forest: StackedForest, tree_weights) -> jax.Array:
 
 
 #: per-thread serving context set by the model server's dispatch loop
-#: (serving/batcher.py): carries the tenant label for per-model latency
-#: series and the admission layer's routing verdict. Thread-local by
-#: construction — each batcher worker labels only its own dispatches.
+#: (serving/batcher.py): carries the tenant LABEL for per-model latency
+#: series. Observability only — routing (including the degrade route to
+#: the native walker) is the dispatch registry's (``_resolve_walk``),
+#: never thread-local state. Each batcher worker labels only its own
+#: dispatches.
 _SERVING_TLS = threading.local()
 
 
 @contextlib.contextmanager
-def serving_context(model: str = "", force_native: bool = False
-                    ) -> Iterator[None]:
+def serving_context(model: str = "") -> Iterator[None]:
     """Scope every ``predict_serving`` call on this thread to a tenant.
 
     ``model`` labels the request's ``predict_latency_seconds`` sample
     (``{model="name@vN"}``) so a multi-tenant server's tail latency is
-    scrapeable per model. ``force_native=True`` is the admission layer's
-    degrade route: the request walks the native CPU SoA forest even on a
-    device backend (the device path is DEGRADED — see
-    ``serving/admission.py`` / docs/resilience.md). Contexts nest; the
-    innermost wins. Entering clears :func:`last_route` (exiting
-    deliberately does NOT restore it) so a dispatch that never reaches
-    ``predict_serving`` — e.g. a gblinear booster falling back to the
-    DMatrix predict path — reads as ``""`` afterwards instead of the
-    previous dispatch's stale route."""
-    prev = (getattr(_SERVING_TLS, "model", ""),
-            getattr(_SERVING_TLS, "force_native", False))
+    scrapeable per model. Contexts nest; the innermost wins. Entering
+    clears :func:`last_route` (exiting deliberately does NOT restore it)
+    so a dispatch that never reaches ``predict_serving`` — e.g. a
+    gblinear booster falling back to the DMatrix predict path — reads as
+    ``""`` afterwards instead of the previous dispatch's stale route.
+
+    The old ``force_native`` flag is gone: degrade routing to the native
+    CPU walker is now the ``predict_walk`` table's verdict
+    (``dispatch.resolve`` integrates the ``pallas_predict`` capability
+    state — docs/serving.md, "Degrade routing")."""
+    prev = getattr(_SERVING_TLS, "model", "")
     _SERVING_TLS.model = model
-    _SERVING_TLS.force_native = force_native
     _SERVING_TLS.route = ""
     try:
         yield
     finally:
-        _SERVING_TLS.model, _SERVING_TLS.force_native = prev
+        _SERVING_TLS.model = prev
 
 
 def last_route() -> str:
@@ -322,29 +324,6 @@ def last_route() -> str:
 def _note_route(route: str) -> str:
     _SERVING_TLS.route = route
     return route
-
-
-def _device_route_degraded() -> bool:
-    """True when the resilience layer marks the device predict path
-    unhealthy (any ``pallas_predict`` key DEGRADED/DISABLED): serving
-    sheds the device dispatch entirely and takes the native CPU walker,
-    trading throughput for not queueing behind a faulting device."""
-    from ..resilience import degrade
-
-    return degrade.worst("pallas_predict") != degrade.HEALTHY
-
-
-def _native_route_ok(forest: StackedForest) -> bool:
-    if forest.has_cats \
-            or os.environ.get("XGBTPU_NATIVE_SERVING", "1") == "0":
-        return False
-    if jax.default_backend() == "cpu":
-        return True
-    # device backend: only when the admission layer forced the native
-    # route or the device path is degraded (docs/serving.md "SLO-aware
-    # admission")
-    return (getattr(_SERVING_TLS, "force_native", False)
-            or _device_route_degraded())
 
 
 def _tree_weights_np(forest: StackedForest, tree_weights) -> np.ndarray:
@@ -508,14 +487,19 @@ def _predict_serving_impl(
             out = _transform_bucketed(out, transform, K)
         return out[:n]
     sparse = hasattr(X, "dense_rows")
-    if n and _native_route_ok(forest):
-        margin = _native_margin(forest, X.csr if sparse else X, base,
-                                tree_weights)
-        if margin is not None:
-            _note_route("native")
-            if transform is None:
-                return margin
-            return _transform_bucketed(margin, transform, K)
+    dec = _resolve_walk(forest)
+    if dec.impl == "native":
+        if n:
+            margin = _native_margin(forest, X.csr if sparse else X, base,
+                                    tree_weights)
+            if margin is not None:
+                _note_route("native")
+                if transform is None:
+                    return margin
+                return _transform_bucketed(margin, transform, K)
+        # the walker's runtime envelope rejected this input (or n == 0):
+        # re-resolve without it — same table, next candidate
+        dec = _resolve_walk(forest, exclude=("native",))
     if sparse:  # bucket path is dense: one densify implementation
         X = X.toarray()
     bucket = bucket_rows(n)
@@ -527,7 +511,7 @@ def _predict_serving_impl(
         "value", getattr(transform, "__qualname__", repr(transform)))
     key = (bucket, X.shape[1], _forest_sig(forest), out_kind)
 
-    if _shared_pallas_route(forest):
+    if dec.impl == "pallas":
         # shared dispatcher (pallas walk + blacklist): the cache entry is a
         # thin closure — bucketing still de-dups compiles inside it. The
         # forest is a runtime ARGUMENT (never captured): entries are keyed

@@ -24,12 +24,15 @@ queues). This module is the decision layer in front of the micro-batcher:
   acceptance pin; the dequeue-side half is
   :class:`~xgboost_tpu.serving.tenancy.TenantFairQueue`).
 - **degrade routing** — when the resilience layer marks the device predict
-  path unhealthy (``degrade.worst("pallas_predict")`` != HEALTHY), the
-  admission verdict routes dispatches to the native CPU SoA walker
-  (``predictor/serving.py`` ``serving_context(force_native=True)``): the
-  server keeps answering at reduced throughput instead of queueing behind
-  a faulting device path. State transitions stay owned by the capability
-  machine (docs/resilience.md); this layer only *reads* it.
+  path unhealthy (the ``pallas_predict`` capability gating the
+  ``predict_walk`` op's device impls), the kernel dispatch registry
+  resolves dispatches to the native CPU SoA walker
+  (``dispatch.resolve("predict_walk", ...)`` inside ``predict_serving``
+  — docs/serving.md, "Degrade routing"): the server keeps answering at
+  reduced throughput instead of queueing behind a faulting device path.
+  State transitions stay owned by the capability machine
+  (docs/resilience.md); this layer only *reads* the table's verdict to
+  count ``serving_degraded_routes_total``.
 - **fault-plane sheds** (ISSUE 10, ``serving/faults.py``) — a request for
   a model whose **circuit breaker** is OPEN sheds with reason
   ``breaker`` (the half-open probe is the one admitted exception); a
@@ -217,10 +220,16 @@ class AdmissionController:
 
     # ------------------------------------------------------------------
     def route_native(self) -> bool:
-        """The degrade machine's routing verdict for the next dispatch:
-        True = serve through the native CPU SoA walker. Counted so the
-        perf cliff is visible in the exposition while it lasts."""
-        if degrade.worst("pallas_predict") != degrade.HEALTHY:
+        """Whether the next dispatch will be degrade-routed to the native
+        CPU SoA walker — the dispatch registry's verdict for the
+        ``predict_walk`` op's capabilities (read-only: no retry countdown
+        burns). Counted so the perf cliff is visible in the exposition
+        while it lasts; the route itself is resolved inside
+        ``predict_serving`` via ``dispatch.resolve``, this method is the
+        admission plane's observability hook."""
+        from .. import dispatch
+
+        if dispatch.degraded("predict_walk"):
             self._degraded_routes.inc()
             return True
         return False

@@ -429,12 +429,16 @@ class MicroBatcher:
                 self._resolve_err(req, self.admission.shed_at_dispatch())
                 continue
             groups.setdefault(req.group_key, []).append(req)
-        force_native = self.admission.route_native() if groups else False
+        if groups:
+            # observability only: counts serving_degraded_routes_total
+            # while the device predict path is unhealthy — the route
+            # itself is the predict_walk dispatch table's verdict, read
+            # inside predict_serving, not a flag threaded through here
+            self.admission.route_native()
         for grp in groups.values():
-            self._dispatch_group(grp, force_native, gen)
+            self._dispatch_group(grp, gen)
 
-    def _dispatch_group(self, grp: List[_Request],
-                        force_native: bool, gen: int) -> None:
+    def _dispatch_group(self, grp: List[_Request], gen: int) -> None:
         from ..predictor.serving import bucket_rows, last_route
 
         first = grp[0]
@@ -461,8 +465,7 @@ class MicroBatcher:
             return first.entry.predict(
                 X, predict_type=first.predict_type,
                 iteration_range=first.iteration_range,
-                missing=first.missing, base_margin=first.base_margin,
-                force_native=force_native)
+                missing=first.missing, base_margin=first.base_margin)
 
         # the isolation ladder (faults.py): clean traffic costs exactly
         # one dispatch() call; classification/retry/bisection only run

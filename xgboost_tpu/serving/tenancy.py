@@ -378,14 +378,17 @@ class ModelEntry:
 
     # -- the dispatch the batcher runs ------------------------------------
     def predict(self, X, *, predict_type: str = "value",
-                iteration_range=None, missing=np.nan, base_margin=None,
-                force_native: bool = False) -> np.ndarray:
+                iteration_range=None, missing=np.nan,
+                base_margin=None) -> np.ndarray:
         """One coalesced dispatch through the bucketed serving fast path,
         scoped to this tenant (per-model ``predict_latency_seconds``
-        labels; ``force_native`` is the admission layer's degrade route)."""
+        labels). Routing — including the degrade route to the native CPU
+        walker — is resolved inside the fast path by the kernel dispatch
+        registry (``dispatch.resolve("predict_walk", ...)``), not passed
+        down here."""
         from ..predictor.serving import serving_context
 
-        with serving_context(model=self.label, force_native=force_native):
+        with serving_context(model=self.label):
             return self.booster.inplace_predict(
                 X, predict_type=predict_type,
                 iteration_range=iteration_range, missing=missing,

@@ -418,13 +418,24 @@ def _grow_tree_fused_impl(
         # specialize the matmul M-dim to the level's true width (the
         # whole point of unrolling on TPU) and bake heap offsets into the
         # kernel grid.
-        from .hist_kernel import fused_level_scanned, use_native_hist
+        from ..dispatch import Ctx, resolve
+        from . import hist_kernel as _hk
+        from .hist_kernel import fused_level_scanned
 
         Km = 1 << (max_depth - 1)
         st = _init_state(cfg, F, G0, H0, B, ptab_rows=Km)
-        native = (cfg.axis_name is None
-                  and bins.dtype in (jnp.uint8, jnp.uint16)
-                  and use_native_hist())
+        # the per-level kernel inside the scan resolves through the same
+        # level_hist table as the unrolled loop (pins, degrade state and
+        # the FFI availability probe apply identically); `native` is a
+        # static flag because the scan body stages ONE program
+        native = resolve("level_hist", Ctx(
+            platform=jax.default_backend(), pallas=False,
+            interpret=bool(_hk._INTERPRET), rows=int(n),
+            features=int(F), nodes=int(Km),
+            bins=int(B), table_width=int(st.ptab.shape[-1]),
+            bins_dtype=str(bins.dtype),
+            sharded=cfg.axis_name is not None,
+            onehot_width=0)).impl == "native"
 
         def _level_body(carry, d):
             st, pos = carry
@@ -480,18 +491,20 @@ def _grow_tree_fused_impl(
 
 def _use_depth_scan(cfg: GrowParams, pallas: bool, max_depth: int) -> bool:
     """Whether the level loop runs as one lax.scan (the fused depth scan)
-    instead of unrolled per-level bodies. Off for: the pallas path (Mosaic
-    kernels specialize per level width by design), categorical trees (the
-    widened decision table is level-shaped), meshes (the unrolled loop is
-    the proven shard_map path) and ``XGBTPU_DEPTH_SCAN=0`` (escape
-    hatch)."""
-    import os
+    instead of unrolled per-level bodies — resolved through the dispatch
+    registry (``depth_scan``: scanned > unrolled). The scanned driver is
+    inapplicable on the pallas path (Mosaic kernels specialize per level
+    width by design), for categorical trees (the widened decision table
+    is level-shaped) and under meshes (the unrolled loop is the proven
+    shard_map path); the legacy ``XGBTPU_DEPTH_SCAN=0`` escape hatch maps
+    to a ``depth_scan=unrolled`` pin."""
+    from ..dispatch import Ctx, resolve
 
-    if pallas or cfg.has_categorical or max_depth < 1:
-        return False
-    if cfg.axis_name is not None:
-        return False
-    return os.environ.get("XGBTPU_DEPTH_SCAN", "1") != "0"
+    return resolve("depth_scan", Ctx(
+        platform=jax.default_backend(), pallas=bool(pallas),
+        has_cats=bool(cfg.has_categorical),
+        sharded=cfg.axis_name is not None,
+        depth=int(max_depth))).impl == "scanned"
 
 
 def _pallas_flag(cfg: GrowParams) -> bool:

@@ -117,11 +117,14 @@ def finalize_alloc(alloc: AllocTree, eta, gamma):
     lv = jax.lax.fori_loop(0, M, vbody, lv0)
     lv = jnp.nan_to_num(lv)
 
+    from ..dispatch import Ctx, resolve
     from .hist_kernel import leaf_delta, use_pallas
 
     pad = max(128, 1 << (M - 1).bit_length())
+    dec = resolve("leaf_delta", Ctx(platform=jax.default_backend(),
+                                    pallas=use_pallas()))
     delta = leaf_delta(alloc.positions[:, None], lv, pad,
-                       pallas=use_pallas())
+                       pallas=dec.impl == "pallas")
     return keep, lv, delta
 
 

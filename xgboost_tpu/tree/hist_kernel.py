@@ -47,6 +47,7 @@ __all__ = [
     "fused_level", "fused_level_xla", "fused_level_native",
     "partition_apply", "partition_apply_xla", "leaf_delta",
     "TR", "use_pallas", "use_native_hist", "build_onehot",
+    "pallas_level_fits",
     "hoist_budget_bytes", "can_hoist", "hoist_plan", "device_free_bytes",
 ]
 
@@ -84,10 +85,10 @@ def use_pallas() -> bool:
 # being executed — np.asarray deadlocks, raw buffer reads race the copy;
 # the FFI handler runs synchronously inside the thunk with materialized
 # buffers), so the host round loop stays non-blocking and the scan/pipeline
-# structure above it is unchanged. XGBTPU_NATIVE_HIST=0 kills it.
+# structure above it is unchanged. Route selection lives in the dispatch
+# registry (dispatch/ops.py); the legacy XGBTPU_NATIVE_HIST=0 kill switch
+# maps to a `level_hist=!native` pin there.
 # ---------------------------------------------------------------------------
-
-_ENV_NATIVE_HIST = "XGBTPU_NATIVE_HIST"
 
 _ffi_lock = threading.Lock()
 _ffi_state = {"registered": None}  # None = not tried, True/False = result
@@ -123,11 +124,13 @@ def _ensure_ffi() -> bool:
 
 def use_native_hist() -> bool:
     """Whether the native (FFI custom call) histogram path is usable:
-    CPU backend, kernel tests not forcing interpret mode, kill switch not
-    set, and the on-demand library builds/loads/registers."""
-    import os
+    CPU backend, kernel tests not forcing interpret mode, the dispatch
+    layer not pinning it off (the legacy ``XGBTPU_NATIVE_HIST=0`` kill
+    switch maps to a ``level_hist=!native`` pin there), and the on-demand
+    library builds/loads/registers."""
+    from ..dispatch import pinned_off
 
-    if os.environ.get(_ENV_NATIVE_HIST) == "0":
+    if pinned_off("level_hist", "native"):
         return False
     if _INTERPRET or jax.default_backend() != "cpu":
         return False
@@ -156,18 +159,18 @@ def fused_level_native(bins, pos, gh, ptab, *, K, Kp, B, d=None,
         K=K, Kp=Kp, B=B)
 
 
-def _native_ok(bins, ptab, axis_name) -> bool:
-    """Trace-time gate for the native FFI path."""
-    return (axis_name is None and ptab.shape[-1] == 4
-            and bins.dtype in (jnp.uint8, jnp.uint16)
-            and use_native_hist())
-
-
 def partition_apply(bins, pos, ptab, *, Kp: int, B: int, d: int,
                     axis_name=None):
     """Route rows through level ``d-1``'s decisions: the native FFI kernel
-    on the CPU path, XLA everywhere else (identical integer decisions)."""
-    if _native_ok(bins, ptab, axis_name):
+    when the dispatch registry resolves ``level_partition`` to it (CPU
+    path), XLA everywhere else (identical integer decisions)."""
+    from ..dispatch import Ctx, resolve
+
+    dec = resolve("level_partition", Ctx(
+        platform=jax.default_backend(), interpret=bool(_INTERPRET),
+        table_width=int(ptab.shape[-1]), bins_dtype=str(bins.dtype),
+        sharded=axis_name is not None))
+    if dec.impl == "native":
         from jax.extend import ffi as jffi
 
         n, F = bins.shape
@@ -402,14 +405,18 @@ def build_onehot(bins: jax.Array, *, B: int, vma=()) -> jax.Array:
     (see ``_build_onehot_pallas``), elsewhere by XLA broadcast-compare
     (small shapes only — tests, narrow matrices). ``vma`` annotates the
     output's varying axes when building inside ``shard_map``."""
+    from ..dispatch import Ctx, resolve
     from ..observability import trace
 
     n, F = bins.shape
     with trace.span("onehot_build", rows=int(n), features=int(F), B=B):
-        if use_pallas() or _INTERPRET:
-            tr = _build_tr(n, F, B)
-            if F > 0 and tr:
-                return _build_onehot_pallas(bins, B=B, tr=tr, vma=vma)
+        dec = resolve("onehot_build", Ctx(
+            platform=jax.default_backend(),
+            pallas=bool(use_pallas() or _INTERPRET),
+            rows=int(n), features=int(F), bins=int(B)))
+        if dec.impl == "pallas":
+            return _build_onehot_pallas(bins, B=B, tr=_build_tr(n, F, B),
+                                        vma=vma)
         return _build_onehot_xla(bins, B=B)
 
 
@@ -776,32 +783,59 @@ def _hoist_tr(Qh: int, K: int, F: int, B: Optional[int] = None) -> int:
     return 0
 
 
+def pallas_level_fits(rows: int, F: int, K: int, B: int,
+                      onehot_width: int = 0) -> bool:
+    """Whether SOME pallas level kernel fits this level's working set:
+    the hoisted streaming kernel (when a resident one-hot of
+    ``onehot_width`` lanes exists and a row tile divides ``rows``) or the
+    in-kernel construction (feature/accumulator VMEM gates). The
+    ``level_hist`` registry predicate (dispatch/ops.py) and the kernel
+    branch below share this single model so they cannot disagree."""
+    if onehot_width:
+        tr = _hoist_tr(onehot_width, K, F, B)
+        if tr and rows % tr == 0:
+            return True
+    return F <= _MAX_KERNEL_FEATURES and F * 2 * K * B * 4 <= _VMEM_ACC_BUDGET
+
+
 def fused_level(bins, pos, gh, ptab, *, K, Kp, B, d, pallas: bool,
                 onehot: Optional[jax.Array] = None,
                 axis_name: Optional[str] = None):
     """Dispatch: (new pos [n,1] i32, hist [F, 2K, B] f32). ``hist`` excludes
     the missing bin (derive per-feature missing sums as total - sum).
-    ``onehot`` (the HBM-resident [n, F*B] int8 expansion) selects the
-    streaming kernel; deep levels whose accumulators outgrow VMEM fall back
-    to the in-kernel construction, then to XLA."""
-    F = bins.shape[1]
-    acc_bytes = F * 2 * K * B * 4
+    The impl is resolved through the kernel dispatch registry
+    (``dispatch.resolve("level_hist", ...)`` — pins, degrade state and
+    platform preference in one lookup). ``onehot`` (the HBM-resident
+    [n, F*B] int8 expansion) selects the streaming kernel inside the
+    pallas impl; deep levels whose accumulators outgrow VMEM fall back to
+    the in-kernel construction, then to native/XLA."""
+    from ..dispatch import Ctx, resolve
+
+    n, F = bins.shape
+    dec = resolve("level_hist", Ctx(
+        platform=jax.default_backend(), pallas=bool(pallas),
+        interpret=bool(_INTERPRET), rows=int(n), features=int(F),
+        nodes=int(K), bins=int(B), table_width=int(ptab.shape[-1]),
+        bins_dtype=str(bins.dtype), sharded=axis_name is not None,
+        onehot_width=0 if onehot is None else int(onehot.shape[1])))
     vma = (axis_name,) if axis_name is not None else ()
-    if pallas and axis_name is not None:
-        # the decision table is replication-proven (it derives from the
-        # psum'd histogram); the pallas boundary wants operands uniformly
-        # varying, so relax it — a no-op on device
-        ptab = jax.lax.pcast(ptab, (axis_name,), to="varying")
-    if pallas and onehot is not None:
-        tr = _hoist_tr(onehot.shape[1], K, F, B)
-        if tr and bins.shape[0] % tr == 0:
-            return _hoisted_level_pallas(bins, onehot, pos, gh, ptab,
-                                         K=K, Kp=Kp, B=B, d=d, tr=tr,
-                                         vma=vma)
-    if pallas and F <= _MAX_KERNEL_FEATURES and acc_bytes <= _VMEM_ACC_BUDGET:
+    if dec.impl == "pallas":
+        if axis_name is not None:
+            # the decision table is replication-proven (it derives from
+            # the psum'd histogram); the pallas boundary wants operands
+            # uniformly varying, so relax it — a no-op on device
+            ptab = jax.lax.pcast(ptab, (axis_name,), to="varying")
+        if onehot is not None:
+            tr = _hoist_tr(onehot.shape[1], K, F, B)
+            if tr and n % tr == 0:
+                return _hoisted_level_pallas(bins, onehot, pos, gh, ptab,
+                                             K=K, Kp=Kp, B=B, d=d, tr=tr,
+                                             vma=vma)
+        # reaching here means pallas_level_fits passed via the in-kernel
+        # construction gates, so the plain kernel is safe
         return _fused_level_pallas(bins, pos, gh, ptab, K=K, Kp=Kp, B=B,
                                    d=d, vma=vma)
-    if _native_ok(bins, ptab, axis_name):
+    if dec.impl == "native":
         return fused_level_native(bins, pos, gh, ptab, K=K, Kp=Kp, B=B, d=d)
     return fused_level_xla(bins, pos, gh, ptab, K=K, Kp=Kp, B=B, d=d)
 
