@@ -443,8 +443,9 @@ def _predict_bench(xgb, X, y, args, suffix: str, final_predict: dict) -> None:
         print(f"# inplace latency {bs} rows: {latency[bs]:.2f} ms",
               file=sys.stderr, flush=True)
 
+    served_info = None
     try:
-        _served_bench(bst, Xs)
+        served_info = _served_bench(bst, Xs)
     except Exception as e:  # noqa: BLE001 — the server stage must never
         # cost the primary predict metric
         print(f"# served bench failed ({type(e).__name__}: {e}); skipping",
@@ -471,6 +472,10 @@ def _predict_bench(xgb, X, y, args, suffix: str, final_predict: dict) -> None:
         "unit": "rows/s",
         "vs_baseline": ratio,
     })
+    if served_info:
+        # the concurrent-vs-sequential serving acceptance rides the
+        # predict BENCH line (ISSUE 15 satellite)
+        final_predict.update(served_info)
     _log_partial({"config": "predict", "rows": rows,
                   "dmatrix_rps": round(rps_d, 1),
                   "inplace_rps": round(rps_i, 1),
@@ -503,18 +508,16 @@ def _served_bench(bst, Xs: np.ndarray, n_threads: int = 8,
     total_rows = sum(n for _, n in reqs)
 
     # sequential baseline: one caller, one dispatch per request
-    bst.inplace_predict(Xs[:16])  # warm
-    t0 = time.perf_counter()
-    for lo, n in reqs:
-        bst.inplace_predict(Xs[lo:lo + n])
-    seq_s = time.perf_counter() - t0
+    def run_sequential():
+        t0 = time.perf_counter()
+        for lo, n in reqs:
+            bst.inplace_predict(Xs[lo:lo + n])
+        return time.perf_counter() - t0
 
     srv = ModelServer(batch_wait_us=500)
     try:
         srv.load("bench", bst)
-        srv.predict("bench", Xs[:16])  # warm the served path too
-        d0 = counter("serving_dispatches_total")
-        b0 = counter("serving_requests_batched_total")
+        srv.predict("bench", Xs[:16])
         shards = [reqs[k::n_threads] for k in range(n_threads)]
         errors = []
 
@@ -525,14 +528,39 @@ def _served_bench(bst, Xs: np.ndarray, n_threads: int = 8,
             except Exception as e:  # noqa: BLE001 — surfaced below
                 errors.append(repr(e))
 
-        threads = [threading.Thread(target=client, args=(s,))
-                   for s in shards]
-        t0 = time.perf_counter()
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
-        served_s = time.perf_counter() - t0
+        def run_stream():
+            threads = [threading.Thread(target=client, args=(s,))
+                       for s in shards]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            return time.perf_counter() - t0
+
+        # untimed warm passes for BOTH paths first: the concurrent
+        # clients produce COALESCED batch sizes (row buckets the
+        # sequential loop never touches) whose first-touch compiles must
+        # not read as serving slowness — same fairness rule as the
+        # routed stage. Then the timed passes INTERLEAVE (seq, served,
+        # ...) x5, MEAN each: single-core wall clock drifts in phases
+        # (frequency/cache state — observed a 1.7x spread on the
+        # identical sequential loop across whole-process runs), so
+        # alternating exposes both paths to the same phases and the mean
+        # compares them over the same wall-clock window.
+        run_sequential()
+        run_stream()
+        if errors:
+            raise RuntimeError(f"{len(errors)} warm requests failed: "
+                               f"{errors[0]}")
+        d0 = counter("serving_dispatches_total")
+        b0 = counter("serving_requests_batched_total")
+        seq_times, served_times = [], []
+        for _ in range(5):
+            seq_times.append(run_sequential())
+            served_times.append(run_stream())
+        seq_s = sum(seq_times) / len(seq_times)
+        served_s = sum(served_times) / len(served_times)
         if errors:
             raise RuntimeError(f"{len(errors)} served requests failed: "
                                f"{errors[0]}")
@@ -547,10 +575,16 @@ def _served_bench(bst, Xs: np.ndarray, n_threads: int = 8,
         srv.close()
     served_rps = total_rows / max(served_s, 1e-9)
     seq_rps = total_rows / max(seq_s, 1e-9)
+    # acceptance (ISSUE 15 satellite): the concurrent micro-batched stream
+    # must not fall below the same stream run sequentially — the batcher's
+    # idle fast-path exists exactly for this number (a lone request no
+    # longer pays the coalescing window)
+    concurrent_ok = served_rps >= seq_rps
     print(f"# predict_served_rows_per_s={served_rps:,.0f} "
           f"(sequential {seq_rps:,.0f} rows/s, {n_threads} threads, "
           f"{n_requests} ragged reqs, coalescing {coalesce:.1f} req/dispatch"
-          f" over {dispatches:.0f} dispatches)",
+          f" over {dispatches:.0f} dispatches)"
+          + ("" if concurrent_ok else " CONCURRENT-BELOW-SEQUENTIAL FAILED"),
           file=sys.stderr, flush=True)
     stage_ms = {
         stage: {k: round(v * 1e3, 3) for k, v in qs.items()}
@@ -564,11 +598,15 @@ def _served_bench(bst, Xs: np.ndarray, n_threads: int = 8,
                   "metric": "predict_served_rows_per_s",
                   "value": round(served_rps, 1),
                   "sequential_rows_per_s": round(seq_rps, 1),
+                  "concurrent_ge_sequential": concurrent_ok,
                   "threads": n_threads, "requests": n_requests,
                   "rows": total_rows,
                   "coalesce_ratio": round(coalesce, 2),
                   "dispatches": int(dispatches),
                   "stage_latency_ms": stage_ms})
+    return {"served_rows_per_s": round(served_rps, 1),
+            "served_sequential_rows_per_s": round(seq_rps, 1),
+            "concurrent_ge_sequential": concurrent_ok}
 
 
 def _routed_bench(bst, Xs: np.ndarray, n_threads: int = 4,
@@ -704,6 +742,109 @@ def _routed_bench(bst, Xs: np.ndarray, n_threads: int = 4,
                   "rows": total_rows, "replicas": 2,
                   "reroutes": int(reroutes),
                   "parity": parity, "parity_ok": parity_ok})
+
+
+def _ingest_bench(X: np.ndarray, max_bin: int) -> float:
+    """DMatrix-construction (sketch + bin) speedup of the dispatch-routed
+    data plane vs the XLA route at the same shape (ISSUE 15 acceptance:
+    >= 3x at 100k x 50 on CPU). Returns the measured speedup (0.0 when the
+    routes resolve identically, e.g. the native toolchain is absent)."""
+    from xgboost_tpu import dispatch
+    from xgboost_tpu.data.quantile import BinnedMatrix
+
+    rows = min(len(X), 100_000)
+    Xs = np.ascontiguousarray(X[:rows])
+
+    def build() -> float:
+        t0 = time.perf_counter()
+        bm = BinnedMatrix.from_dense(Xs, max_bin=max_bin)
+        np.asarray(bm.bins)
+        return time.perf_counter() - t0
+
+    build()  # warm the active route's compile
+    t_fast = min(build(), build())
+    route = dispatch.last_decisions().get("sketch_cuts", "?")
+    if route == "xla":
+        print("# ingest bench: sketch_cuts already resolves to xla "
+              "(native toolchain absent?); no speedup to report",
+              file=sys.stderr, flush=True)
+        return 0.0
+    prev = os.environ.get("XGBTPU_DISPATCH")
+    os.environ["XGBTPU_DISPATCH"] = (
+        (prev + "," if prev else "") + "sketch_cuts=xla,bin_matrix=xla")
+    try:
+        build()  # warm the XLA route's compile
+        t_xla = min(build(), build())  # best-of-2, same as the routed side
+    finally:
+        if prev is None:
+            os.environ.pop("XGBTPU_DISPATCH", None)
+        else:
+            os.environ["XGBTPU_DISPATCH"] = prev
+    speedup = t_xla / max(t_fast, 1e-9)
+    print(f"# dmatrix ingest (sketch+bin) {rows // 1000}kx{Xs.shape[1]} "
+          f"bin{max_bin}: {route}={t_fast:.3f}s xla={t_xla:.3f}s "
+          f"-> {speedup:.2f}x", file=sys.stderr, flush=True)
+    _log_partial({"config": "ingest", "rows": rows, "max_bin": max_bin,
+                  "route": route,
+                  "seconds_routed": round(t_fast, 3),
+                  "seconds_xla": round(t_xla, 3),
+                  "speedup": round(speedup, 2)})
+    return round(speedup, 2)
+
+
+def _paged_bench(xgb, X: np.ndarray, y: np.ndarray, args) -> dict:
+    """Prefetch-overlapped external-memory stage (ISSUE 15): a few paged
+    training rounds with the flight split showing the overlap — time
+    blocked on an in-flight prefetch (``prefetch_wait``) vs synchronous
+    page ingest (``ingest``). Returns the paged-stage flight deltas for
+    the BENCH line. ``XGBTPU_BENCH_PAGED=0`` skips the stage."""
+    from xgboost_tpu.data.external import ExternalMemoryQuantileDMatrix
+    from xgboost_tpu.data.iterator import DataIter
+    from xgboost_tpu.observability import flight
+
+    rows = min(len(X), 100_000)
+    Xs, ys = np.ascontiguousarray(X[:rows]), y[:rows]
+    n_parts = 4
+    step = -(-rows // n_parts)
+
+    class _It(DataIter):
+        def __init__(self):
+            self.i = 0
+
+        def reset(self):
+            self.i = 0
+
+        def next(self, input_data):
+            if self.i >= n_parts:
+                return 0
+            lo = self.i * step
+            input_data(data=Xs[lo:lo + step], label=ys[lo:lo + step])
+            self.i += 1
+            return 1
+
+    bin_ = args.tuned_max_bin or args.max_bin
+    params = {"objective": "binary:logistic", "tree_method": args.tree_method,
+              "max_depth": args.max_depth, "max_bin": bin_, "verbosity": 0}
+    stages0 = flight.stage_totals()
+    t0 = time.perf_counter()
+    d = ExternalMemoryQuantileDMatrix(_It(), max_bin=bin_, page_rows=step)
+    rounds = 3
+    xgb.train(params, d, rounds, verbose_eval=False)
+    wall = time.perf_counter() - t0
+    now = flight.stage_totals()
+    delta = {k: round(now.get(k, 0.0) - stages0.get(k, 0.0), 3)
+             for k in ("ingest", "prefetch_wait")}
+    print(f"# paged train {rows // 1000}kx{Xs.shape[1]} bin{bin_} "
+          f"{rounds}r ({n_parts} pages): {wall:.1f}s — "
+          f"ingest={delta['ingest']:.3f}s "
+          f"prefetch_wait={delta['prefetch_wait']:.3f}s "
+          "(overlap = reads absorbed by the background decode)",
+          file=sys.stderr, flush=True)
+    _log_partial({"config": "paged", "rows": rows, "pages": n_parts,
+                  "rounds": rounds, "seconds": round(wall, 3),
+                  "ingest_s": delta["ingest"],
+                  "prefetch_wait_s": delta["prefetch_wait"]})
+    return {"prefetch_wait": delta["prefetch_wait"]}
 
 
 def _report_arithmetic_intensity() -> None:
@@ -1008,6 +1149,24 @@ def _run_configs(args, suffix: str, final: dict) -> None:
             print(f"# reference-default gate run failed "
                   f"({type(e).__name__}: {e}); keeping the banked tuned "
                   "metric", file=sys.stderr, flush=True)
+
+    # ---- data-plane stages (ISSUE 15): ingest speedup + paged overlap ----
+    try:
+        speedup = _ingest_bench(X, primary_bin)
+        if speedup:
+            final["ingest_speedup"] = speedup
+    except Exception as e:  # informational: never dent the train metric
+        print(f"# ingest bench failed ({type(e).__name__}: {e}); skipping",
+              file=sys.stderr, flush=True)
+    if os.environ.get("XGBTPU_BENCH_PAGED", "1") != "0":
+        try:
+            pg = _paged_bench(xgb, X, y, args)
+            extra = {k: v for k, v in pg.items() if v > 0}
+            if extra:
+                final.setdefault("stages", {}).update(extra)
+        except Exception as e:
+            print(f"# paged bench failed ({type(e).__name__}: {e}); "
+                  "skipping", file=sys.stderr, flush=True)
 
     # ---- serving benchmark: the second metric line. Never allowed to ----
     # ---- disturb the completed training measurement.                 ----

@@ -39,8 +39,13 @@ echo "=== tier 0.5: kernel dispatch report (all ops resolve on CPU) ==="
 # the resolved kernel table is a CI artifact: rc != 0 means some op has
 # NO usable implementation on this platform — a broken registry entry
 # fails here before a single test compiles (docs/perf.md, "Choosing a
-# kernel")
-python -m xgboost_tpu dispatch-report
+# kernel"). The data-plane ops (ISSUE 15) must be rows in the table.
+REPORT_OUT=$(python -m xgboost_tpu dispatch-report)
+echo "$REPORT_OUT"
+for op in sketch_cuts bin_matrix; do
+  echo "$REPORT_OUT" | grep -q "$op" || {
+    echo "dispatch-report missing data-plane op: $op"; exit 1; }
+done
 
 echo "=== tier 1: full suite (8-device virtual mesh, traced) ==="
 TRACE_OUT=$(mktemp /tmp/xgbtpu_ci_trace.XXXXXX.json)
@@ -196,6 +201,80 @@ assert bst.save_raw() == clean.save_raw(), \
     "resume after a pipelined-round fault diverged from a clean run"
 print(f"pipelined-round chaos OK: fault at sync attributed to round "
       f"{err.pipeline_round}, checkpoint chain consistent")
+EOF
+
+# Data-plane chaos (ISSUE 15): paged external-memory training with the
+# prefetch overlap admitted, async checkpointing on, and seeded transient
+# faults at BOTH data-plane sites — pager_io (fires on the prefetch
+# worker) and checkpoint_write (fires on the async writer thread). The
+# retries must absorb them off-thread, the flight recorder must show the
+# prefetch_wait/ingest stage split (the overlap is measurable), the run
+# must resume bit-identical from its verified checkpoints, and the two
+# data-plane dispatch ops must have resolved.
+XGBTPU_CHAOS="pager_io:transient:2,5;checkpoint_write:transient:1,3" \
+XGBTPU_RETRY="*=3" XGBTPU_PIPELINE_DEPTH=2 python - <<'EOF'
+import tempfile
+
+import numpy as np
+
+import xgboost_tpu as xgb
+from xgboost_tpu import dispatch
+from xgboost_tpu.data.external import ExternalMemoryQuantileDMatrix
+from xgboost_tpu.data.iterator import DataIter
+from xgboost_tpu.observability import REGISTRY, flight
+from xgboost_tpu.resilience import chaos
+
+plan = chaos.active_plan()
+assert plan is not None and len(plan.specs) == 2, "chaos env not armed"
+
+rng = np.random.RandomState(0)
+X = rng.randn(2400, 6).astype(np.float32)
+y = (X[:, 0] > 0).astype(np.float32)
+
+def make_paged():
+    class It(DataIter):
+        def __init__(self): self.i = 0
+        def reset(self): self.i = 0
+        def next(self, input_data):
+            if self.i >= 3: return 0
+            lo = self.i * 800
+            input_data(data=X[lo:lo + 800], label=y[lo:lo + 800])
+            self.i += 1
+            return 1
+    return ExternalMemoryQuantileDMatrix(It(), max_bin=16, page_rows=800)
+
+params = {"objective": "binary:logistic", "max_depth": 3, "max_bin": 16,
+          "verbosity": 0}
+ck = tempfile.mkdtemp()
+s0 = flight.stage_totals()
+bst = xgb.train(params, make_paged(), 4, verbose_eval=False,
+                resume_from=ck, checkpoint_interval=1)
+assert bst.num_boosted_rounds() == 4
+stages = flight.stage_totals()
+assert stages.get("prefetch_wait", 0) > s0.get("prefetch_wait", 0), \
+    f"prefetch overlap never admitted: {stages}"
+assert stages.get("ingest", 0) > 0, stages
+fired = {f[0] for f in plan.fired}
+assert fired == {"pager_io", "checkpoint_write"}, plan.fired
+exp = REGISTRY.exposition()
+assert 'faults_total{kind="transient",site="pager_io"}' in exp
+assert 'faults_total{kind="transient",site="checkpoint_write"}' in exp
+# verified resume: the async-written chain replays bit-identical
+resumed = xgb.train(params, make_paged(), 4, verbose_eval=False,
+                    resume_from=ck, checkpoint_interval=1)
+assert resumed.save_raw() == bst.save_raw(), \
+    "resume from async-written checkpoints diverged"
+routes = dispatch.last_decisions()
+# pass 2 of the out-of-core ingest quantizes through bin_matrix; the
+# external path's sketch is the distributed summary (not sketch_cuts),
+# so that op is resolved against its report ctx here
+assert routes.get("bin_matrix") in ("native", "xla"), routes
+sk = dispatch.resolve("sketch_cuts")
+assert sk.impl in ("native", "xla"), sk
+print(f"data-plane chaos OK: {len(plan.fired)} faults absorbed off-thread, "
+      f"prefetch_wait={stages['prefetch_wait']*1e3:.1f}ms, "
+      f"routes sketch_cuts={sk.impl} "
+      f"bin_matrix={routes.get('bin_matrix')}, verified resume bit-identical")
 EOF
 
 echo "=== tier 1.6: elastic chaos lane (seeded worker_kill + obs-report) ==="

@@ -55,3 +55,9 @@ def _reset_resilience_state():
     # decisions (the cache key includes env + capability state, but the
     # route-change history and last-decision map are cumulative)
     dispatch.reset()
+    # the async checkpoint writer parks a failed write's exception for
+    # the next sync point — drain and drop it so a chaos test's injected
+    # fault never surfaces inside an unrelated later test
+    from xgboost_tpu.resilience import checkpoint as _ckpt
+
+    _ckpt.async_writer().reset()

@@ -308,9 +308,17 @@ def test_abandoned_future_skipped_at_dispatch_assembly(model):
     try:
         srv.load("m", bst)
         a0 = _counter("serving_requests_total", outcome="abandoned")
-        f1 = srv.predict_async("m", X[:1])
-        time.sleep(0.02)  # the worker holds f1's cycle open (batch wait)
-        assert f1.cancel(), "future should still be cancellable in-window"
+        # cancel a just-submitted future before the worker claims it (the
+        # ISSUE 15 idle fast-path dispatches a fully-assembled batch
+        # immediately, so the old hold-the-window setup is gone; the GIL
+        # makes an instant cancel win in practice — retry the rare loss)
+        for _ in range(5):
+            f1 = srv.predict_async("m", X[:1])
+            cancelled = f1.cancel()
+            if cancelled:
+                break
+            f1.result(60)  # lost the race: it dispatched — drain, retry
+        assert cancelled, "cancel never won the claim race"
         f2 = srv.predict_async("m", X[1:3])
         np.testing.assert_array_equal(
             f2.result(60), np.asarray(bst.inplace_predict(X[1:3])))

@@ -368,7 +368,14 @@ class DMatrix:
         ``GetBatches<GHistIndexMatrix>(BatchParam{max_bin})``)."""
         bm = self._binned.get(max_bin)
         if bm is None:
-            bm = self.build_binned(max_bin, sketch_weights)
+            from ..observability import trace
+
+            # one span per COLD construction: the data-plane ingest cost
+            # (sketch + quantize, routed through the sketch_cuts /
+            # bin_matrix dispatch ops) — cache hits pay nothing
+            with trace.span("dmatrix_build", rows=self.num_row(),
+                            features=self.num_col(), max_bin=max_bin):
+                bm = self.build_binned(max_bin, sketch_weights)
             self._binned[max_bin] = bm
         return bm
 

@@ -18,7 +18,8 @@ from __future__ import annotations
 
 import os
 import tempfile
-from typing import Any, List, Optional  # noqa: F401
+import time
+from typing import Any, List, Optional, Tuple  # noqa: F401
 
 import jax.numpy as jnp
 import numpy as np
@@ -86,6 +87,16 @@ class PagedBins:
         self.n_pages = -(-n_rows // page_rows)
         self._handle = None
         self._lib = None
+        # host-side decode prefetch (ISSUE 15 tentpole): one in-flight
+        # background read+unpack, admitted by the paged grower right after
+        # it dispatches page k's level work so the NEXT page's disk read
+        # AND symbol unpack overlap the in-flight device compute. The
+        # native pager below already read-ahead at the C level; this slot
+        # moves the Python-side decode (unpack_symbols + retry wrapper)
+        # off the critical path too — and gives the numpy-file fallback a
+        # prefetcher at all.
+        self._pf: Optional[Tuple[int, Any]] = None
+        self._pf_pool = None
         # ELLPACK symbol compression: log2(bins+1) bits per entry on disk
         # (bin ids 0..max_bin inclusive of the missing sentinel). Packing
         # is skipped when it wouldn't shrink the page.
@@ -157,17 +168,60 @@ class PagedBins:
                 self.prefix.encode(), self.n_pages, sizes, 4
             )
 
-    def read_page(self, k: int) -> np.ndarray:
-        """[rows_of(k), F] narrow-int bins; prefetch of k+1 starts in the
-        native worker before this call returns. Pages are stored
-        bit-packed (``self.bits`` per entry) and unpacked here. Page IO is
-        the ``pager_io`` resilience site: transient read failures (a
-        flaky disk, injected chaos) are retried under ``XGBTPU_RETRY``
-        before surfacing."""
+    def start_prefetch(self, k: int) -> None:
+        """Begin decoding page ``k`` on the background worker (read +
+        retry + unpack) WITHOUT blocking; :meth:`read_page` consumes the
+        result. One slot: a second call while one is in flight is a
+        no-op, as is an out-of-range ``k`` or ``XGBTPU_PAGE_PREFETCH=0``
+        (the sync escape hatch — data is byte-identical either way, the
+        env var only kills the overlap)."""
+        if (self._pf is not None or not (0 <= k < self.n_pages)
+                or os.environ.get("XGBTPU_PAGE_PREFETCH") == "0"):
+            return
+        if self._pf_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._pf_pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="xgbtpu-page-prefetch")
+        self._pf = (k, self._pf_pool.submit(self._read_retry, k))
+
+    def _read_retry(self, k: int) -> np.ndarray:
         from ..resilience import policy
 
         return policy.RetryPolicy("pager_io", retries=2).run(
             self._read_page_once, k)
+
+    def read_page(self, k: int) -> np.ndarray:
+        """[rows_of(k), F] narrow-int bins; prefetch of k+1 starts in the
+        native worker before this call returns. Pages are stored
+        bit-packed (``self.bits`` per entry) and unpacked here (or on the
+        prefetch worker — :meth:`start_prefetch`). Page IO is the
+        ``pager_io`` resilience site: transient read failures (a flaky
+        disk, injected chaos) are retried under ``XGBTPU_RETRY`` before
+        surfacing — a prefetched read's failure surfaces HERE, attributed
+        to its page. Wall time blocked on an in-flight prefetch is
+        charged to the flight recorder's ``prefetch_wait`` stage;
+        synchronous (unprefetched) reads charge ``ingest`` — the split
+        that makes the overlap measurable (docs/observability.md)."""
+        from ..observability import flight
+
+        pf, self._pf = self._pf, None
+        if pf is not None and pf[0] == k:
+            t0 = time.perf_counter()
+            try:
+                return pf[1].result()
+            finally:
+                flight.note("prefetch_wait", time.perf_counter() - t0)
+        if pf is not None:
+            # mismatched prefetch (random access / a fresh sweep): drop
+            # it — never observed on the sequential streaming path, and
+            # blocking here would charge the wrong page
+            pf[1].cancel()
+        t0 = time.perf_counter()
+        try:
+            return self._read_retry(k)
+        finally:
+            flight.note("ingest", time.perf_counter() - t0)
 
     def _read_page_once(self, k: int) -> np.ndarray:
         from ..resilience import chaos
@@ -191,6 +245,10 @@ class PagedBins:
         return raw.view(self.dtype).reshape(rows, self.n_features)
 
     def close(self) -> None:
+        if self._pf_pool is not None:
+            self._pf = None
+            self._pf_pool.shutdown(wait=True)
+            self._pf_pool = None
         if self._handle and self._lib is not None:
             self._lib.pc_close(self._handle)
             self._handle = None
@@ -221,6 +279,9 @@ class ExternalMemoryQuantileDMatrix(DMatrix):
     def __init__(self, it: DataIter, *, cache_prefix: Optional[str] = None,
                  max_bin: int = 256, missing: float = np.nan,
                  page_rows: int = 262_144) -> None:
+        from ..observability import flight
+
+        t_ing = time.perf_counter()
         self.max_bin = max_bin
         if cache_prefix is None:
             cache_prefix = os.path.join(
@@ -334,6 +395,9 @@ class ExternalMemoryQuantileDMatrix(DMatrix):
 
             self.info.group_ptr = _group_ptr_from_qid(np.concatenate(qparts))
         self._binned = {max_bin: paged}
+        # 2-pass out-of-core ingest (sketch sweep + quantize/spill sweep):
+        # the data plane's 'ingest' flight stage
+        flight.note("ingest", time.perf_counter() - t_ing)
 
     def get_binned(self, max_bin: int, weights=None):
         if max_bin != self.max_bin:

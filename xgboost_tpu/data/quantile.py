@@ -24,7 +24,8 @@ value lands in ``[0, max_bin)``. Missing values get the dedicated bin id
 from __future__ import annotations
 
 import dataclasses
-from functools import partial
+import threading
+from functools import lru_cache, partial
 from typing import Optional, Sequence, Tuple
 
 import jax
@@ -111,7 +112,24 @@ def _cuts_kernel(X: jax.Array, weights: jax.Array, max_bin: int):
     svals = jnp.take_along_axis(keys, order, axis=1)
     w = jnp.where(valid, weights[None, :], 0.0)
     sw = jnp.take_along_axis(w, order, axis=1)
-    cdf = jnp.cumsum(sw, axis=1)  # [F, n]
+    if jax.default_backend() == "cpu":
+        # explicitly SEQUENTIAL f32 prefix sum: XLA:CPU's cumsum lowering
+        # may reassociate the adds (parallel prefix), which flips a
+        # quantile selection on a near-tie — the native sketch kernel
+        # (native/sketch_bin.cpp) accumulates sequentially, and the two
+        # routes are pinned bit-identical, so the reference route must
+        # accumulate in the same order. CPU-only: on device backends a
+        # 100k-step scan would serialize the sketch for no contract (the
+        # native route never runs there).
+        def _step(acc, col):
+            acc = acc + col
+            return acc, acc
+
+        _, cdf_t = jax.lax.scan(
+            _step, jnp.zeros((Xt.shape[0],), sw.dtype), sw.T)
+        cdf = cdf_t.T  # [F, n]
+    else:
+        cdf = jnp.cumsum(sw, axis=1)  # [F, n]
     total = cdf[:, -1:]
 
     # quantile levels for the max_bin-1 interior cuts at k/B of total weight;
@@ -130,6 +148,100 @@ def _cuts_kernel(X: jax.Array, weights: jax.Array, max_bin: int):
     interior = jnp.where((n_valid > 0)[:, None], interior, 0.0)
     cuts = jnp.concatenate([interior, sentinel[:, None]], axis=1)  # [F, B]
     return cuts, min_val
+
+
+# ---------------------------------------------------------------------------
+# Native sketch + binning (ISSUE 15 tentpole): XLA FFI custom calls
+# (native/sketch_bin.cpp) doing the same float ops in the same order as the
+# XLA kernels above/below — BIT-IDENTICAL cuts and bins (pinned), ~an order
+# of magnitude faster on XLA:CPU where the sort/searchsorted pipeline was
+# the DMatrix-construction floor. Routed per call through the kernel
+# dispatch registry (ops ``sketch_cuts`` / ``bin_matrix`` — docs/perf.md,
+# "The data plane"), so pins (XGBTPU_DISPATCH) and platform preference
+# apply like any other kernel op.
+# ---------------------------------------------------------------------------
+
+_sketch_ffi_lock = threading.Lock()
+_sketch_ffi_state = {"registered": None}  # None = not tried
+
+
+def _ensure_sketch_ffi() -> bool:
+    """Build/load the native sketch+bin library and register its FFI
+    handlers with XLA (once per process). False when the toolchain or the
+    jax FFI API is unavailable — the dispatch table then resolves the ops
+    to the XLA impls."""
+    with _sketch_ffi_lock:
+        if _sketch_ffi_state["registered"] is not None:
+            return _sketch_ffi_state["registered"]
+        _sketch_ffi_state["registered"] = False
+        try:
+            from jax.extend import ffi as jffi
+
+            from ..native import get_sketch_lib
+
+            lib = get_sketch_lib()
+            if lib is None:
+                return False
+            jffi.register_ffi_target(
+                "xgbtpu_sketch_cuts", jffi.pycapsule(lib.XgbtpuSketchCuts),
+                platform="cpu")
+            jffi.register_ffi_target(
+                "xgbtpu_bin_matrix_u8", jffi.pycapsule(lib.XgbtpuBinMatrixU8),
+                platform="cpu")
+            jffi.register_ffi_target(
+                "xgbtpu_bin_matrix_u16",
+                jffi.pycapsule(lib.XgbtpuBinMatrixU16), platform="cpu")
+            _sketch_ffi_state["registered"] = True
+        except Exception:
+            return False
+        return True
+
+
+@lru_cache(maxsize=64)
+def _native_cuts_prog(n: int, F: int, B: int):
+    """Jitted wrapper around the XgbtpuSketchCuts custom call for one
+    shape (the jit guarantees executable caching for eager invocation)."""
+    from jax.extend import ffi as jffi
+
+    def run(X, w):
+        return jffi.ffi_call(
+            "xgbtpu_sketch_cuts",
+            (jax.ShapeDtypeStruct((F, B), jnp.float32),
+             jax.ShapeDtypeStruct((F,), jnp.float32)),
+            X, w, B=B)
+
+    return jax.jit(run)
+
+
+@lru_cache(maxsize=64)
+def _native_bins_prog(n: int, F: int, B: int, dtype_name: str):
+    from jax.extend import ffi as jffi
+
+    target = ("xgbtpu_bin_matrix_u8" if dtype_name == "uint8"
+              else "xgbtpu_bin_matrix_u16")
+
+    def run(X, cut_values):
+        return jffi.ffi_call(
+            target, jax.ShapeDtypeStruct((n, F), jnp.dtype(dtype_name)),
+            X, cut_values)
+
+    return jax.jit(run)
+
+
+def _cuts_dispatch(Xj: jax.Array, wj: jax.Array, max_bin: int):
+    """(cut values [F, B], min vals [F]) for one dense block, routed
+    through the ``sketch_cuts`` dispatch op. Shared by the whole-matrix
+    sketch and the CSR column-blocked sketch so both take the same route
+    (and stay bit-identical to each other)."""
+    from ..dispatch import Ctx, resolve
+
+    n, F = int(Xj.shape[0]), int(Xj.shape[1])
+    dec = resolve("sketch_cuts", Ctx(
+        platform=jax.default_backend(), rows=n, features=F,
+        bins=int(max_bin)))
+    if dec.impl == "native":
+        return _native_cuts_prog(n, F, int(max_bin))(Xj, wj)
+    return _cuts_kernel(Xj, wj, max_bin)
 
 
 def compute_cuts(
@@ -156,7 +268,7 @@ def compute_cuts(
     t0 = time.perf_counter()
     with trace.span("sketch", rows=int(X.shape[0]), features=int(X.shape[1]),
                     max_bin=max_bin):
-        values, min_vals = _cuts_kernel(X, weights, max_bin)
+        values, min_vals = _cuts_dispatch(X, weights, max_bin)
         values = np.array(values)
         min_vals = np.array(min_vals)
     flight.note("sketch", time.perf_counter() - t0)
@@ -245,6 +357,24 @@ def storage_dtype(max_bin: int):
     return jnp.int32
 
 
+def _bins_dispatch(Xj: jax.Array, cut_values: jax.Array, dtype) -> jax.Array:
+    """Quantize one dense block to the narrow storage dtype, routed
+    through the ``bin_matrix`` dispatch op. The native impl writes the
+    narrow u8/u16 ids directly (no int32 intermediate); the XLA impl is
+    the original searchsorted kernel plus the cast."""
+    from ..dispatch import Ctx, resolve
+
+    n, F = int(Xj.shape[0]), int(Xj.shape[1])
+    B = int(cut_values.shape[1])
+    name = np.dtype(dtype).name
+    dec = resolve("bin_matrix", Ctx(
+        platform=jax.default_backend(), rows=n, features=F, bins=B,
+        bins_dtype=name))
+    if dec.impl == "native":
+        return _native_bins_prog(n, F, B, name)(Xj, cut_values)
+    return _bin_kernel(Xj, cut_values).astype(dtype)
+
+
 def bin_matrix(X: np.ndarray | jax.Array, cuts: HistogramCuts) -> jax.Array:
     """Quantize a dense matrix against cuts. Analog of
     ``GHistIndexMatrix::Init`` / ELLPACK packing (``gradient_index.cc:199``)."""
@@ -253,8 +383,8 @@ def bin_matrix(X: np.ndarray | jax.Array, cuts: HistogramCuts) -> jax.Array:
     with trace.span("quantize", rows=int(np.shape(X)[0]),
                     max_bin=cuts.max_bin):
         Xj = jnp.asarray(X, dtype=jnp.float32)
-        bins = _bin_kernel(Xj, jnp.asarray(cuts.values))
-        return bins.astype(storage_dtype(cuts.max_bin))
+        return _bins_dispatch(Xj, jnp.asarray(cuts.values),
+                              storage_dtype(cuts.max_bin))
 
 
 @dataclasses.dataclass
@@ -523,6 +653,11 @@ class BinnedMatrix:
         usual dense narrow-int ELLPACK layout (reference sparse inputs
         likewise quantize into GHistIndex/Ellpack pages,
         ``gradient_index.cc:199``)."""
+        import time
+
+        from ..observability import flight
+
+        t_ing = time.perf_counter()
         n, F = storage.shape
         cat = tuple(categorical) if categorical else ()
         if weights is None or (hasattr(weights, "size") and weights.size == 0):
@@ -536,7 +671,7 @@ class BinnedMatrix:
             mins = np.empty((F,), np.float32)
             for f0, f1 in blocks:
                 Xb = storage.dense_cols(f0, f1)
-                v, m = _cuts_kernel(jnp.asarray(Xb), w, max_bin)
+                v, m = _cuts_dispatch(jnp.asarray(Xb), w, max_bin)
                 vals[f0:f1] = np.asarray(v)
                 mins[f0:f1] = np.asarray(m)
             cuts = HistogramCuts(values=vals, min_vals=mins)
@@ -547,8 +682,8 @@ class BinnedMatrix:
         cut_j = jnp.asarray(cuts.values)
         for f0, f1 in blocks:
             Xb = storage.dense_cols(f0, f1)
-            bb = _bin_kernel(jnp.asarray(Xb), cut_j[f0:f1])
-            bins[:, f0:f1] = np.asarray(bb.astype(dtype))
+            bb = _bins_dispatch(jnp.asarray(Xb), cut_j[f0:f1], dtype)
+            bins[:, f0:f1] = np.asarray(bb)
         counts: Tuple[int, ...] = ()
         if cat:
             maxes = []
@@ -557,8 +692,12 @@ class BinnedMatrix:
                 cv = cv[~np.isnan(cv)]
                 maxes.append(float(cv.max()) if cv.size else np.nan)
             counts = tuple(int(m) + 1 if np.isfinite(m) else 1 for m in maxes)
-        return cls(cuts=cuts, bins=jnp.asarray(bins), categorical=cat,
-                   cat_counts=counts)
+        out = cls(cuts=cuts, bins=jnp.asarray(bins), categorical=cat,
+                  cat_counts=counts)
+        # DMatrix-construction wall time: the data plane's 'ingest' flight
+        # stage (sketch + quantize + conversion — docs/observability.md)
+        flight.note("ingest", time.perf_counter() - t_ing)
+        return out
 
     @classmethod
     def from_dense(
@@ -569,6 +708,11 @@ class BinnedMatrix:
         cuts: Optional[HistogramCuts] = None,
         categorical: Optional[Sequence[int]] = None,
     ) -> "BinnedMatrix":
+        import time
+
+        from ..observability import flight
+
+        t_ing = time.perf_counter()
         cat = tuple(categorical) if categorical else ()
         counts: Tuple[int, ...] = ()
         if cat:
@@ -582,4 +726,7 @@ class BinnedMatrix:
             )
         if cuts is None:
             cuts = compute_cuts(X, max_bin=max_bin, weights=weights, categorical=cat)
-        return cls(cuts=cuts, bins=bin_matrix(X, cuts), categorical=cat, cat_counts=counts)
+        out = cls(cuts=cuts, bins=bin_matrix(X, cuts), categorical=cat,
+                  cat_counts=counts)
+        flight.note("ingest", time.perf_counter() - t_ing)
+        return out

@@ -57,6 +57,12 @@ class _PredCache:
     def __init__(self) -> None:
         self.margin: Optional[jax.Array] = None  # [n, K]
         self.num_trees: int = 0
+        # whether the cached margin may have come from the predict_walk
+        # dispatch route's NATIVE walker (double accumulation — off by
+        # ~1 ulp from the device path): the TRAINING margin read
+        # (_cached_margin) must never consume such an entry, or resumed
+        # runs would stop being bit-identical to uninterrupted ones
+        self.native: bool = False
 
 
 class Booster:
@@ -180,7 +186,9 @@ class Booster:
             # dropout changes old-tree weights: always a fresh dropped pass
             base = self._base_margin_for(dtrain, n)
             return self._gbm.training_margin(dtrain.data, base)
-        return self._predict_margin(dtrain)
+        # native_ok=False: gradients must stay byte-stable regardless of
+        # how eval/predict walks are routed (ISSUE 15)
+        return self._predict_margin(dtrain, native_ok=False)
 
     # ------------------------------------------------------------------
     # training
@@ -473,7 +481,9 @@ class Booster:
     def _eval_set(self, evals, iteration: int, feval=None) -> str:
         parts = [f"[{iteration}]"]
         for dmat, name in evals:
-            margin = self._predict_margin(dmat)
+            # the per-eval-round walk rides the predict_walk dispatch
+            # route (native on CPU) — ISSUE 15 tentpole (d)
+            margin = self._predict_margin(dmat, native_ok=True)
             preds = self._obj.eval_transform(margin[:, 0] if self.n_groups == 1 else margin)
             info = dmat.info
             for metric in self._resolve_metrics():
@@ -614,13 +624,39 @@ class Booster:
                 self._forest_snapshots.popitem(last=False)
         return forest, tw
 
-    def _predict_margin(self, dmat: DMatrix, iteration_range=None) -> jax.Array:
+    def _predict_margin(self, dmat: DMatrix, iteration_range=None,
+                        native_ok: bool = False) -> jax.Array:
+        """``native_ok`` (ISSUE 15 tentpole (d)): the EVAL path
+        (``_eval_set``) routes its per-round walks through the
+        ``predict_walk`` kernel dispatch op — the same table the serving
+        plane resolves, which on CPU picks the native SoA walker
+        (order-of-magnitude faster than the XLA gather walk; pin away
+        with ``XGBTPU_DISPATCH=predict_walk=xla``). Everything else —
+        the training margin read (``_cached_margin``) AND the public
+        ``predict`` path — keeps ``native_ok=False``: the native walker
+        accumulates in double (≈1 ulp off the device path), gradients
+        must stay byte-stable so resumed runs remain bit-identical, and
+        ``predict`` results must be bit-stable regardless of
+        prediction-cache state (cached margins are device-accumulated;
+        tests/test_c_api.py pins fresh-load vs cached equality)."""
         self._configure()
         n = dmat.num_row()
         base = self._base_margin_for(dmat, n)
-        if iteration_range is not None and self._gbm.name in ("gbtree", "dart"):
-            from .predictor import predict_margin as _pm
+        from .predictor import predict_margin as _pm_xla
+        from .predictor import walk_margin as _pm_walk
 
+        _pm = _pm_walk if native_ok else _pm_xla
+        # conservative taint marker for cache entries the dispatch route
+        # MAY have filled through the native walker. Deliberately NOT
+        # keyed on the backend: device platforms route to the native
+        # walker too when pallas_predict is degraded (the dispatch
+        # table's reason="degraded" fallback), and an untainted native
+        # fill there would feed ~1-ulp-off margins to _cached_margin.
+        # The cost of over-tainting is one XLA recompute if a
+        # native_ok=False reader ever consumes such an entry — rare
+        # (training keeps dtrain's cache current itself).
+        _taints = native_ok
+        if iteration_range is not None and self._gbm.name in ("gbtree", "dart"):
             stacked, tw = self._forest_snapshot(iteration_range)
             parts = [_pm(stacked, X, base[blo:bhi], tw)
                      for blo, bhi, X in self._data_blocks(dmat)]
@@ -631,7 +667,9 @@ class Booster:
         # DART is excluded — dropout rescales old trees every round.
         entry = self._caches.get(id(dmat))
         cur = self._gbm.model.num_trees if hasattr(self._gbm, "model") else -1
-        if entry is not None and entry.margin is not None and entry.num_trees == cur:
+        if (entry is not None and entry.margin is not None
+                and entry.num_trees == cur
+                and (native_ok or not entry.native)):
             return entry.margin
         K = self.n_groups
         per_round = max(1, K) * (
@@ -644,12 +682,11 @@ class Booster:
             and self._gbm.name == "gbtree"
             and entry.margin is not None
             and 0 < entry.num_trees < cur
+            and (native_ok or not entry.native)
             # far behind (e.g. predicting after a long training run with no
             # intermediate evals): one full pass beats replaying per-round
             and cur - entry.num_trees <= 16 * per_round
         ):
-            from .predictor import predict_margin as _pm
-
             model = self._gbm.model
             while entry.num_trees < cur:
                 hi = min(entry.num_trees + per_round, cur)
@@ -666,11 +703,21 @@ class Booster:
                          else parts[0])
                 entry.margin = entry.margin + delta
                 entry.num_trees = hi
+                entry.native = entry.native or _taints
             return entry.margin
         if cur == 0:
             # empty model: don't touch dmat.data (streaming matrices
             # reconstruct raw values lazily — the zero-tree margin is base)
             margin = base
+        elif native_ok and self._gbm.name in ("gbtree", "dart"):
+            # full pass through the dispatch-routed walker (the gbm's own
+            # predict stays on the XLA walk — gradient numerics)
+            stacked = self._gbm.model.stacked()
+            tw = self._gbm.tree_weights()
+            parts = [_pm_walk(stacked, X, base[blo:bhi], tw)
+                     for blo, bhi, X in self._data_blocks(dmat)]
+            margin = (jnp.concatenate(parts, axis=0) if len(parts) > 1
+                      else parts[0] if parts else base)
         else:
             # stream whatever the matrix is backed by: quantized disk
             # pages, CSR row blocks, or one dense array (_data_blocks)
@@ -681,6 +728,7 @@ class Booster:
         if entry is not None and self._gbm.name == "gbtree":
             entry.margin = margin
             entry.num_trees = cur
+            entry.native = _taints
         return margin
 
     def predict(

@@ -310,6 +310,42 @@ def get_hist_lib() -> Optional[ctypes.CDLL]:
         return _hb_lib
 
 
+_SB_SRC = os.path.join(_HERE, "sketch_bin.cpp")
+_SB_LIB = os.path.join(_HERE, "libsketchbin.so")
+_sb_lib: Optional[ctypes.CDLL] = None
+_sb_tried = False
+
+
+def get_sketch_lib() -> Optional[ctypes.CDLL]:
+    """Load (building on demand) the native quantile-sketch + binning
+    kernel (``sketch_bin.cpp`` — the data-plane fast path the ``sketch_cuts``
+    / ``bin_matrix`` dispatch ops resolve to on CPU; ``data/quantile.py``
+    registers the exported ``XgbtpuSketchCuts``/``XgbtpuBinMatrixU8``/
+    ``XgbtpuBinMatrixU16`` handler symbols as XLA FFI targets). None when
+    the toolchain or the jaxlib FFI headers are unavailable (callers fall
+    back to the XLA sort/searchsorted path)."""
+    global _sb_lib, _sb_tried
+    with _lock:
+        if _sb_lib is not None or _sb_tried:
+            return _sb_lib
+        _sb_tried = True
+        try:
+            from jax.extend import ffi as _jffi
+
+            inc = _jffi.include_dir()
+        except Exception:
+            return None
+        lp = _lib_variant(_SB_LIB)
+        if not _compile(_SB_SRC, lp,
+                        ["-O3", "-march=native", "-std=c++17", f"-I{inc}"]):
+            return None
+        try:
+            _sb_lib = ctypes.CDLL(lp)
+        except OSError:
+            return None
+        return _sb_lib
+
+
 _CAPI_SRC = os.path.join(_HERE, "c_api.cpp")
 _CAPI_LIB = os.path.join(_HERE, "libxgbtpu.so")
 _capi_path: Optional[str] = None

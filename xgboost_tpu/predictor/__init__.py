@@ -412,6 +412,43 @@ def predict_margin(
     )
 
 
+def walk_margin(
+    forest: StackedForest,
+    X,
+    base_margin: jax.Array,
+    tree_weights: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Whole-matrix margin walk routed through the ``predict_walk``
+    kernel dispatch op (ISSUE 15 tentpole (d)): the training loop's
+    per-eval-round prediction (and the DMatrix predict path) resolve the
+    same table the serving plane uses — on CPU that is the native SoA
+    walker (``native/serving_walk.cpp``, ~an order of magnitude faster
+    than the XLA gather walk), on device backends the pallas/XLA
+    programs. Pins (``XGBTPU_DISPATCH=predict_walk=xla``) and the
+    ``pallas_predict`` degrade state apply exactly as in serving; a
+    native-envelope rejection (input narrower than the forest's widest
+    split, missing toolchain) falls back to :func:`predict_margin`."""
+    if forest.left.shape[0]:
+        from .serving import _native_margin, _resolve_walk
+
+        dec = _resolve_walk(forest)
+        if dec.impl == "native":
+            base = np.ascontiguousarray(np.asarray(base_margin, np.float32))
+            if base.ndim == 1:
+                base = base[:, None]
+            out = _native_margin(forest, np.asarray(X, np.float32), base,
+                                 tree_weights)
+            if out is not None:
+                return jnp.asarray(out)
+            # runtime envelope rejection (input narrower than the
+            # forest's widest split, lib failed to load): re-resolve
+            # with the native impl excluded — same fallback contract as
+            # the serving path, so dispatch_decisions_total attributes
+            # the walk to the impl that actually serves it
+            _resolve_walk(forest, exclude=("native",))
+    return predict_margin(forest, X, base_margin, tree_weights)
+
+
 def predict_leaf(forest: StackedForest, X: jax.Array) -> jax.Array:
     """[n, T] leaf indices (reference: pred_leaf)."""
     if forest.left.shape[0] == 0:

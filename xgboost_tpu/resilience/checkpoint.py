@@ -33,7 +33,8 @@ import hashlib
 import json
 import os
 import re
-from typing import List, Optional, Tuple
+import threading
+from typing import Any, Dict, List, Optional, Tuple
 
 from . import chaos, policy
 
@@ -41,6 +42,7 @@ __all__ = [
     "FORMAT", "checkpoint_path", "save_checkpoint", "read_checkpoint",
     "load_latest", "list_checkpoints", "process_dir", "inspect_dir",
     "verify_checkpoint", "path_rounds", "atomic_write_bytes",
+    "AsyncCheckpointWriter", "async_writer", "async_enabled",
 ]
 
 FORMAT = "xgbtpu-ckpt-v1"
@@ -75,12 +77,15 @@ def process_dir(directory: str, shared: bool = False) -> str:
 
 
 def atomic_write_bytes(path: str, data: bytes) -> None:
-    """Durable atomic file write: pid-unique tmp + fsync + ``os.replace``
-    + directory fsync. The ONE implementation behind checkpoints, the
-    elastic generation file and membership tombstones — pid-unique tmp
-    names mean concurrent ranks writing identical payloads into a shared
-    directory commute instead of interleaving one tmp file."""
-    tmp = f"{path}.tmp.{os.getpid()}"
+    """Durable atomic file write: pid+thread-unique tmp + fsync +
+    ``os.replace`` + directory fsync. The ONE implementation behind
+    checkpoints, the elastic generation file and membership tombstones —
+    pid-unique tmp names mean concurrent ranks writing identical payloads
+    into a shared directory commute instead of interleaving one tmp file
+    (the thread id extends the same guarantee to the async checkpoint
+    writer thread racing an abort-path synchronous save in one
+    process)."""
+    tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
     with open(tmp, "wb") as f:
         f.write(data)
         f.flush()
@@ -100,23 +105,27 @@ def atomic_write_bytes(path: str, data: bytes) -> None:
 
 def _write_atomic(path: str, header: bytes, payload: bytes) -> None:
     chaos.hit("checkpoint_write")
+    delay = os.environ.get("XGBTPU_TEST_CKPT_WRITE_DELAY")
+    if delay:  # test hook: widen the SIGKILL-mid-write window
+        import time
+
+        time.sleep(float(delay))
     atomic_write_bytes(path, header + b"\n" + payload)
 
 
-def save_checkpoint(directory: str, booster, rounds: int, *,
-                    retain: int = 2) -> str:
-    """Atomically write ``booster``'s state as the checkpoint for
-    ``rounds`` completed boosting rounds; prune to the ``retain`` newest
-    AFTER the write lands (so a previous good snapshot always survives
-    the one in flight). The write itself runs under the ``checkpoint_write``
-    retry policy — transient IO faults (including injected chaos) are
-    absorbed up to the ``XGBTPU_RETRY`` budget (default 2 retries)."""
+def _commit_payload(directory: str, payload: bytes, rounds: int,
+                    retain: int, stage: str = "checkpoint") -> str:
+    """Hash + header + atomic write + retention prune for an already-
+    serialized model payload — the half of ``save_checkpoint`` that runs
+    on the async writer thread (charged to the flight stage the caller
+    names: ``checkpoint`` on the synchronous path, ``checkpoint_io`` on
+    the writer thread so the round loop's own blocked time stays
+    distinguishable)."""
     import time
 
     from ..observability.metrics import REGISTRY
     from ..observability import flight, trace
 
-    payload = booster.save_raw()
     header = json.dumps({
         "format": FORMAT,
         "rounds": int(rounds),
@@ -129,7 +138,7 @@ def save_checkpoint(directory: str, booster, rounds: int, *,
                     bytes=len(payload)):
         policy.RetryPolicy("checkpoint_write", retries=2).run(
             _write_atomic, path, header, payload)
-    flight.note("checkpoint", time.perf_counter() - t0)
+    flight.note(stage, time.perf_counter() - t0)
     REGISTRY.counter(
         "checkpoints_written_total", "Atomic checkpoints committed").inc()
     for old in list_checkpoints(directory)[:-retain] if retain else []:
@@ -138,6 +147,217 @@ def save_checkpoint(directory: str, booster, rounds: int, *,
         except OSError:
             pass
     return path
+
+
+def save_checkpoint(directory: str, booster, rounds: int, *,
+                    retain: int = 2) -> str:
+    """Atomically write ``booster``'s state as the checkpoint for
+    ``rounds`` completed boosting rounds; prune to the ``retain`` newest
+    AFTER the write lands (so a previous good snapshot always survives
+    the one in flight). The write itself runs under the ``checkpoint_write``
+    retry policy — transient IO faults (including injected chaos) are
+    absorbed up to the ``XGBTPU_RETRY`` budget (default 2 retries)."""
+    return _commit_payload(directory, booster.save_raw(), rounds, retain)
+
+
+# ---------------------------------------------------------------------------
+# Async checkpoint I/O (ISSUE 15 tentpole): byte serialization + hashing +
+# fsync + rename move to ONE writer thread (the PR 8 async-appender
+# pattern), so the round loop's only checkpoint cost is capturing the model
+# snapshot at its sync point — it blocks again ONLY when the previous write
+# is still in flight at the next checkpoint boundary. The PR 4
+# atomic/checksummed contract is untouched: the writer runs the exact same
+# ``_commit_payload`` (tmp + fsync + rename + dir fsync + checksum header +
+# retention), so a SIGKILL at any instant still leaves old-file-or-new and
+# resume stays bit-identical (tests/test_data_plane.py).
+#
+# Consistency: the JSON document snapshot (``booster.save_json()`` — the
+# tree walk) is captured on the CALLER'S thread at the blessed sync point,
+# because the next round's update mutates the model while the writer runs;
+# the returned document references only committed, immutable tree state,
+# so the byte encode (``json.dumps`` — the bulk of serialization cost for
+# big models), hashing and all file I/O run safely off-thread.
+#
+# Failure surfacing: a write that exhausts its ``checkpoint_write`` retry
+# budget parks the exception; the NEXT submit/wait (both blessed sync
+# points) re-raises it with ``.checkpoint_rounds`` attributed, plus a
+# ``checkpoint_fault`` flight event at failure time.
+# ---------------------------------------------------------------------------
+
+_ASYNC_ENV = "XGBTPU_ASYNC_CKPT"
+
+
+def async_enabled() -> bool:
+    """Whether checkpoint writes run on the writer thread
+    (``XGBTPU_ASYNC_CKPT=0`` is the synchronous escape hatch)."""
+    return os.environ.get(_ASYNC_ENV) != "0"
+
+
+class AsyncCheckpointWriter:
+    """One-slot background checkpoint committer. Thread-safe; one
+    process-wide instance (:func:`async_writer`)."""
+
+    def __init__(self) -> None:
+        self._cond = threading.Condition(threading.Lock())
+        self._task: Optional[Tuple[str, Dict[str, Any], int, int]] = None
+        self._busy = False
+        # parked failures KEYED BY DIRECTORY: two concurrent trainings in
+        # one process (each with its own resume_from) share the writer
+        # thread, and run A's exhausted retries must surface at A's next
+        # sync point — never abort run B's healthy training
+        self._errors: Dict[str, BaseException] = {}
+        self._thread: Optional[threading.Thread] = None
+        self._newest: Dict[str, int] = {}  # directory -> newest rounds
+        self._current: Optional[Tuple[str, int]] = None  # write in flight
+
+    # ------------------------------------------------------------------
+    def submit(self, directory: str, booster, rounds: int, *,
+               retain: int = 2) -> None:
+        """Capture ``booster``'s state (caller thread — the sync point)
+        and enqueue the commit. Blocks only while the PREVIOUS write is
+        still in flight (charged to the flight ``checkpoint`` stage);
+        re-raises a parked failure from an earlier write."""
+        import time
+
+        from ..observability import flight
+
+        doc = booster.save_json()  # consistent structural snapshot
+        with self._cond:
+            self._raise_pending_locked(directory)
+            t0 = time.perf_counter()
+            while self._busy:
+                self._cond.wait()
+            waited = time.perf_counter() - t0
+            self._raise_pending_locked(directory)
+            self._task = (directory, doc, int(rounds), int(retain))
+            self._busy = True
+            self._newest[directory] = int(rounds)
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._run, name="xgbtpu-ckpt-writer", daemon=True)
+                self._thread.start()
+            self._cond.notify_all()
+        if waited > 0:
+            flight.note("checkpoint", waited)
+
+    def wait(self, directory: Optional[str] = None) -> None:
+        """Drain: block until the in-flight write lands; re-raise a
+        parked failure. The end-of-training / abort-path barrier — a
+        checkpoint is durable once this returns. With ``directory`` set,
+        waits only while THAT directory's write is in flight and raises
+        only its parked failure (another training's concurrent write is
+        not this caller's business); with None, drains everything and
+        raises any parked failure (tests/reset)."""
+        import time
+
+        from ..observability import flight
+
+        with self._cond:
+            t0 = time.perf_counter()
+            while self._busy and (directory is None
+                                  or self._inflight_dir() == directory):
+                self._cond.wait()
+            waited = time.perf_counter() - t0
+            self._raise_pending_locked(directory)
+        if waited > 0:
+            flight.note("checkpoint", waited)
+
+    def _inflight_dir(self) -> Optional[str]:
+        """Directory of the queued-or-writing task (callers hold the
+        lock)."""
+        if self._task is not None:
+            return self._task[0]
+        return self._current[0] if self._current is not None else None
+
+    def newest_submitted(self, directory: str) -> Optional[int]:
+        """Newest rounds submitted for ``directory`` this process (landed
+        or still in flight)."""
+        with self._cond:
+            return self._newest.get(directory)
+
+    def covered(self, directory: str, rounds: int) -> bool:
+        """The async probe-before-write: True when a commit for
+        ``(directory, rounds)`` is either still IN FLIGHT (the on-disk
+        probe cannot see it yet) or was submitted here and its file is
+        still on disk. Deletion-safe: a memo hit whose file has since
+        been removed (directory wiped between runs in one process)
+        returns False so the caller re-commits instead of silently
+        skipping the write."""
+        with self._cond:
+            if self._newest.get(directory) != int(rounds):
+                return False
+            # in flight = queued (not yet picked up) or being written
+            if self._task is not None and self._task[0] == directory \
+                    and self._task[2] == int(rounds):
+                return True
+            if self._current == (directory, int(rounds)):
+                return True
+        return os.path.exists(checkpoint_path(directory, rounds))
+
+    def reset(self) -> None:
+        """Tests: drain without raising, drop parked errors and the
+        submitted-rounds memo."""
+        with self._cond:
+            while self._busy:
+                self._cond.wait()
+            self._errors.clear()
+            self._newest.clear()
+
+    # ------------------------------------------------------------------
+    def _raise_pending_locked(self, directory: Optional[str]) -> None:
+        if directory is None:
+            for d in list(self._errors):
+                raise self._errors.pop(d)
+            return
+        e = self._errors.pop(directory, None)
+        if e is not None:
+            raise e
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while self._task is None:
+                    self._cond.wait()
+                directory, doc, rounds, retain = self._task
+                self._task = None
+                self._current = (directory, rounds)
+            try:
+                payload = json.dumps(doc).encode()
+                _commit_payload(directory, payload, rounds, retain,
+                                stage="checkpoint_io")
+            except BaseException as e:  # parked for the next sync point
+                try:
+                    e.checkpoint_rounds = rounds  # type: ignore[attr-defined]
+                except Exception:
+                    pass
+                with self._cond:
+                    self._errors.setdefault(directory, e)
+                try:
+                    from ..observability import flight as _flight
+
+                    _flight.RECORDER.event(
+                        "checkpoint_fault", rounds=int(rounds),
+                        error=type(e).__name__, detail=str(e)[:200])
+                except Exception:
+                    pass  # attribution must never mask the fault
+            finally:
+                with self._cond:
+                    self._busy = False
+                    self._current = None
+                    self._cond.notify_all()
+
+
+_writer_lock = threading.Lock()
+_writer: Optional[AsyncCheckpointWriter] = None
+
+
+def async_writer() -> AsyncCheckpointWriter:
+    """The process-wide checkpoint writer (created on first use)."""
+    global _writer
+    with _writer_lock:
+        if _writer is None:
+            _writer = AsyncCheckpointWriter()
+        return _writer
 
 
 def list_checkpoints(directory: str) -> List[str]:

@@ -173,6 +173,16 @@ class MicroBatcher:
         self._respawns = REGISTRY.counter(
             "serving_worker_respawns_total",
             "Batcher worker threads respawned by the wedge watchdog")
+        self._fastpath = REGISTRY.counter(
+            "serving_batch_fastpath_total",
+            "Dispatches that skipped (part of) the coalescing window "
+            "because every admitted request was already in the batch "
+            "(idle fast-path)")
+        # admitted-but-unresolved requests (queued + in the open batch):
+        # the idle fast-path's signal. A request leaves the count when its
+        # future reaches ANY terminal state (result, typed error, cancel)
+        # via the done-callback attached at submit.
+        self._outstanding = 0
         self._depth.set(0)
         self._dispatches.inc(0)
         self._batched.inc(0)
@@ -308,9 +318,19 @@ class MicroBatcher:
                 predict_type, iteration_range, missing, base_margin,
                 deadline, rec, fp, tenant)
             entry.acquire()
+            self._outstanding += 1
             self._q.put(req, tenant=tenant, cost=float(n))
             self._depth.set(self._q.qsize())
+        # attached OUTSIDE the lock: done-callbacks run synchronously on
+        # whichever thread resolves (or cancels) the future, and must
+        # never fire while this thread holds the batcher lock
+        req.future.add_done_callback(self._on_request_done)
         return req.future
+
+    def _on_request_done(self, _fut) -> None:
+        with self._lock:
+            if self._outstanding > 0:
+                self._outstanding -= 1
 
     # ------------------------------------------------------------------
     def _note_dequeue(self, req: "_Request") -> None:
@@ -331,8 +351,26 @@ class MicroBatcher:
             self._note_dequeue(item)
             batch = [item]
             rows = item.n
+            # idle fast-path (ISSUE 15 satellite): the coalescing window
+            # exists to gather requests that are IN FLIGHT toward the
+            # queue — but when every admitted request is already in this
+            # batch (queue empty and outstanding == len(batch)), nothing
+            # can arrive until these futures resolve: closed-loop clients
+            # are all blocked on THIS batch. Holding the window open then
+            # is a pure stall per dispatch — measured as the concurrent
+            # served stream falling BELOW the same stream run
+            # sequentially (87.2k vs 96.1k rows/s). Dispatch the moment
+            # the live request set is fully assembled; a genuine flood
+            # (more outstanding than batched — e.g. async submitters)
+            # keeps the window exactly as before.
             window_end = time.monotonic() + self.max_wait_s
             while rows < self.max_batch_rows:
+                with self._lock:
+                    drained = (self._q.qsize() == 0
+                               and self._outstanding <= len(batch))
+                if drained:
+                    self._fastpath.inc()
+                    break
                 remaining = window_end - time.monotonic()
                 try:
                     nxt = self._q.get(timeout=max(0.0, remaining)) \

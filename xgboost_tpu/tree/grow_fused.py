@@ -633,6 +633,21 @@ def _grow_tree_fused_paged(
         # narrow dtype preserved off-TPU (native/XLA paths read it as-is)
         return jnp.asarray(arr.astype(np.int32) if pallas else arr)
 
+    # prefetch-overlapped paging (ISSUE 15): right after page k's level
+    # work is DISPATCHED (jax dispatch is async — the host returns while
+    # the device chews), admit the background decode of the next page the
+    # sweep will read, so disk read + symbol unpack overlap the in-flight
+    # compute. k wraps to 0 at the sweep end: the next consumer is the
+    # following level's (or the delta pass's / the NEXT ROUND'S) page-0
+    # read. The very first page-0 read of a tree with no wrapped
+    # prefetch in flight stays SYNCHRONOUS on purpose (charged to
+    # `ingest`): prefetching it here would just move the same blocking
+    # read onto the worker and charge it to `prefetch_wait`, making the
+    # overlap stage read as wait it never hid. Bit-identical to
+    # synchronous reads by construction (same bytes, same order — pinned
+    # by tests/test_data_plane.py).
+    prefetch = getattr(paged, "start_prefetch", lambda k: None)
+
     for d in range(cfg.max_depth):
         K = 1 << d
         Kp = K >> 1
@@ -642,6 +657,7 @@ def _grow_tree_fused_paged(
                 page_bins(k), pos_pages[k], gh_pages[k], st.ptab,
                 K=K, Kp=Kp, B=B, d=d, pallas=pallas,
             )
+            prefetch(k + 1 if k + 1 < P else 0)
             pos_pages[k] = pos_k
             hist = hist + hist_k
         st = _level_update_jit(st, hist, cut_values, tree_mask, k_level,
@@ -658,6 +674,11 @@ def _grow_tree_fused_paged(
                 Kp=1 << (cfg.max_depth - 1), B=B, d=cfg.max_depth,
                 pallas=pallas, pad_nodes=pad_nodes,
             )
+            # wrap-around: page 0's next reader is the NEXT ROUND's first
+            # level — the cross-round half of the prefetch overlap (the
+            # RoundPipeline keeps round i+1's dispatch going while round
+            # i's device work is still in flight)
+            prefetch(k + 1 if k + 1 < P else 0)
         else:
             dlt = leaf_delta(pos_pages[k], leaf_value, pad_nodes,
                              pallas=pallas)
