@@ -1,0 +1,200 @@
+#!/usr/bin/env python
+"""CI perf regression gate (ci.sh tier 0.75).
+
+A small fixed-shape smoke bench — measured in-process, best-of-K batches
+so a single scheduler spike on a loaded CI core cannot fail the lane —
+compared against the checked-in reference envelope
+(``scripts/perf_envelope.json``) with an EXPLICIT noise band::
+
+    python scripts/perf_gate.py --check        # fail on >35% rounds/s drop
+    python scripts/perf_gate.py --self-test    # prove the gate trips on a
+                                               # seeded 2x slowdown
+    python scripts/perf_gate.py --update       # re-measure and rewrite the
+                                               # envelope (new reference box)
+
+The envelope records the box's clean rounds/s for THIS workload plus the
+noise band; the gate fails when measured < envelope * (1 - band). The
+band is wide (35%) on purpose: the gate exists to catch the silent 2-10x
+regressions nothing else guards (the r15 lesson: vs_baseline degraded to
+0.0 and nobody noticed), not to litigate scheduler jitter. ``--check
+--self-test`` runs both in ONE process so the model compiles once.
+
+Exit status: 0 pass, 1 regression (or self-test failing to trip),
+2 usage / missing envelope.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# runnable from anywhere: the repo root (one level up) holds xgboost_tpu
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+ENVELOPE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "perf_envelope.json")
+
+#: the gate's fixed smoke workload — small enough that the whole lane
+#: (compile + warmup + 3 measured batches) stays under ~1 min on one CPU
+#: core, big enough that a round's wall is compute, not Python overhead
+WORKLOAD = {"rows": 50_000, "cols": 20, "max_depth": 5, "max_bin": 32,
+            "seed": 7}
+PARAMS = {"objective": "binary:logistic", "tree_method": "tpu_hist",
+          "verbosity": 0, "max_depth": WORKLOAD["max_depth"],
+          "max_bin": WORKLOAD["max_bin"]}
+WARMUP_ROUNDS = 4
+BATCH_ROUNDS = 8
+BATCHES = 3
+NOISE_BAND = 0.35
+
+
+class _Bench:
+    """One compiled booster, reusable for clean and seeded-slow passes."""
+
+    def __init__(self) -> None:
+        import numpy as np
+        import xgboost_tpu as xgb
+
+        rng = np.random.RandomState(WORKLOAD["seed"])
+        X = rng.rand(WORKLOAD["rows"], WORKLOAD["cols"]).astype(np.float32)
+        y = (X[:, 0] + 0.25 * rng.rand(WORKLOAD["rows"]) > 0.625
+             ).astype(np.float32)
+        self._xgb = xgb
+        self._dtrain = xgb.DMatrix(X, label=y)
+        self._bst = xgb.train(PARAMS, self._dtrain, WARMUP_ROUNDS,
+                              verbose_eval=False)
+        self._round = WARMUP_ROUNDS
+
+    def _sync(self) -> None:
+        import jax
+
+        entry = self._bst._caches.get(id(self._dtrain))
+        if entry is not None and entry.margin is not None:
+            jax.block_until_ready(entry.margin)
+
+    def rounds_per_s(self, slowdown: float = 1.0) -> float:
+        """Best-of-BATCHES rounds/s. ``slowdown`` > 1 seeds a per-round
+        stall of (slowdown - 1) clean round-times — the self-test's
+        synthetic regression."""
+        stall = 0.0
+        if slowdown > 1.0:
+            t0 = time.perf_counter()
+            for _ in range(BATCH_ROUNDS):
+                self._bst.update(self._dtrain, self._round)
+                self._round += 1
+            self._sync()
+            stall = (time.perf_counter() - t0) / BATCH_ROUNDS \
+                * (slowdown - 1.0)
+        best = 0.0
+        for _ in range(BATCHES):
+            t0 = time.perf_counter()
+            for _ in range(BATCH_ROUNDS):
+                self._bst.update(self._dtrain, self._round)
+                self._round += 1
+                if stall:
+                    time.sleep(stall)
+            self._sync()
+            best = max(best, BATCH_ROUNDS / (time.perf_counter() - t0))
+        return best
+
+
+def _load_envelope() -> dict:
+    with open(ENVELOPE) as f:
+        env = json.load(f)
+    if not isinstance(env.get("rounds_per_s"), (int, float)) \
+            or env["rounds_per_s"] <= 0:
+        raise ValueError("envelope has no positive rounds_per_s")
+    return env
+
+
+def floor_of(env: dict) -> float:
+    """The gate threshold: envelope rounds/s minus the noise band."""
+    return float(env["rounds_per_s"]) * (1.0 - float(
+        env.get("noise_band", NOISE_BAND)))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="CI perf regression gate (tier 0.75)")
+    ap.add_argument("--check", action="store_true",
+                    help="measure and compare against the envelope")
+    ap.add_argument("--self-test", action="store_true",
+                    help="prove the gate trips on a seeded 2x slowdown")
+    ap.add_argument("--update", action="store_true",
+                    help="re-measure and rewrite the envelope")
+    ap.add_argument("--slowdown", type=float, default=2.0,
+                    help="self-test slowdown factor (default 2.0)")
+    args = ap.parse_args(argv)
+    if not (args.check or args.self_test or args.update):
+        args.check = True
+
+    bench = _Bench()
+    rc = 0
+
+    if args.update:
+        rps = bench.rounds_per_s()
+        env = {
+            "schema": "perf-envelope-v1",
+            "workload": WORKLOAD,
+            "params": {k: v for k, v in PARAMS.items() if k != "verbosity"},
+            "protocol": {"warmup_rounds": WARMUP_ROUNDS,
+                         "batch_rounds": BATCH_ROUNDS, "batches": BATCHES,
+                         "statistic": "best-of-batches"},
+            "rounds_per_s": round(rps, 3),
+            "noise_band": NOISE_BAND,
+        }
+        with open(ENVELOPE, "w") as f:
+            json.dump(env, f, indent=1)
+            f.write("\n")
+        print(f"perf gate: envelope updated — {rps:.2f} rounds/s, "
+              f"noise band {NOISE_BAND:.0%} -> floor {floor_of(env):.2f} "
+              f"({ENVELOPE})")
+        return 0
+
+    try:
+        env = _load_envelope()
+    except (OSError, ValueError) as e:
+        print(f"perf gate: cannot load envelope {ENVELOPE}: {e} "
+              "(generate one with --update)", file=sys.stderr)
+        return 2
+    floor = floor_of(env)
+
+    if args.check:
+        rps = bench.rounds_per_s()
+        verdict = "PASS" if rps >= floor else "FAIL"
+        print(f"perf gate: measured {rps:.2f} rounds/s vs envelope "
+              f"{env['rounds_per_s']:.2f} (noise band "
+              f"{env.get('noise_band', NOISE_BAND):.0%} -> floor "
+              f"{floor:.2f}) — {verdict}")
+        if rps < floor:
+            print("perf gate: rounds/s regression exceeds the noise band; "
+                  "if this change is a KNOWN perf tradeoff, re-baseline "
+                  "with scripts/perf_gate.py --update", file=sys.stderr)
+            rc = 1
+        elif rps > env["rounds_per_s"] * (1.0 + env.get("noise_band",
+                                                        NOISE_BAND)):
+            print("perf gate: note — measured WELL ABOVE the envelope; "
+                  "consider re-baselining (--update) so the gate keeps "
+                  "teeth", file=sys.stderr)
+
+    if args.self_test:
+        slow = bench.rounds_per_s(slowdown=args.slowdown)
+        tripped = slow < floor
+        print(f"perf gate self-test: seeded {args.slowdown:.1f}x slowdown "
+              f"measured {slow:.2f} rounds/s vs floor {floor:.2f} — "
+              f"{'gate trips, PASS' if tripped else 'gate DID NOT trip, FAIL'}")
+        if not tripped:
+            rc = 1
+
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

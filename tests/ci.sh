@@ -47,6 +47,15 @@ for op in sketch_cuts bin_matrix; do
     echo "dispatch-report missing data-plane op: $op"; exit 1; }
 done
 
+echo "=== tier 0.75: perf regression gate (envelope + seeded self-test) ==="
+# A fixed-shape smoke bench vs the checked-in envelope with an explicit
+# 35% noise band (ISSUE 16): the lane fails on a silent rounds/s
+# regression BEFORE the functional tiers spend their minutes, and the
+# seeded 2x-slowdown self-test proves on every run that the gate still
+# has teeth (a gate that cannot trip is a dead rule — same rationale as
+# the tier-0 lint self-check). One process: the model compiles once.
+python scripts/perf_gate.py --check --self-test
+
 echo "=== tier 1: full suite (8-device virtual mesh, traced) ==="
 TRACE_OUT=$(mktemp /tmp/xgbtpu_ci_trace.XXXXXX.json)
 export XGBTPU_TRACE="$TRACE_OUT"
@@ -275,6 +284,57 @@ print(f"data-plane chaos OK: {len(plan.fired)} faults absorbed off-thread, "
       f"prefetch_wait={stages['prefetch_wait']*1e3:.1f}ms, "
       f"routes sketch_cuts={sk.impl} "
       f"bin_matrix={routes.get('bin_matrix')}, verified resume bit-identical")
+EOF
+
+# Intra-round grow attribution (ISSUE 16): a bench-shaped training
+# (100k x 50, depth 6, bin 64) with the kernel profiler sampling rounds
+# 2 and 4. The sampled rounds' grow_detail records must parse out of the
+# durable flight sink (torn-record tolerant reader), the per-depth x
+# per-op substage walls must sum to within 10% of the round's
+# stages.grow (the measurement contract of docs/perf.md), every level
+# must be attributed to a level_hist bucket, the host-sync count must be
+# on the record, and `grow-report` must render the table from the run
+# dir. Unsampled rounds carry no grow_detail — the profiler is scoped.
+XGBTPU_KERNEL_PROF=rounds=2,4 python - <<'EOF'
+import os, tempfile
+
+import numpy as np
+
+import xgboost_tpu as xgb
+from xgboost_tpu.observability import flight
+from xgboost_tpu.observability.kernelprof import _iter_flight_lines
+
+run_dir = tempfile.mkdtemp(prefix="ci_growprof_")
+flight.configure(run_dir)
+rng = np.random.RandomState(0)
+X = rng.rand(100_000, 50).astype(np.float32)
+y = (X[:, 0] + 0.25 * rng.rand(100_000) > 0.625).astype(np.float32)
+bst = xgb.train({"objective": "binary:logistic", "max_depth": 6,
+                 "max_bin": 64, "verbosity": 0},
+                xgb.DMatrix(X, label=y), 6, verbose_eval=False)
+assert bst.num_boosted_rounds() == 6
+
+path = os.path.join(run_dir, "obs", "rank0", "flight.jsonl")
+rounds = [r for r in _iter_flight_lines(path) if r.get("t") == "round"]
+sampled = {r["round"]: r for r in rounds if "grow_detail" in r}
+assert set(sampled) == {2, 4}, f"sampled rounds wrong: {sorted(sampled)}"
+for i, rec in sorted(sampled.items()):
+    gd = rec["grow_detail"]
+    grow = rec["stages"]["grow"]
+    sub = sum(o["wall_s"] for o in gd["ops"])
+    assert abs(sub - grow) <= 0.10 * grow, \
+        f"round {i}: substages {sub:.3f}s vs stages.grow {grow:.3f}s " \
+        f"({sub / grow:.1%}) — outside the 10% contract"
+    depths = {o["depth"] for o in gd["ops"] if o["op"] == "level_hist"}
+    assert depths == set(range(6)), f"round {i}: levels missing: {depths}"
+    assert gd["host_syncs"] >= len(gd["ops"]), gd
+    assert all(o.get("impl") for o in gd["ops"]), gd["ops"]
+print("grow attribution OK: rounds 2,4 sampled, substage sums within "
+      "10% of stages.grow, all 6 levels attributed")
+
+from xgboost_tpu.cli import cli_main
+rc = cli_main(["grow-report", run_dir])
+assert rc == 0, f"grow-report failed (rc={rc})"
 EOF
 
 echo "=== tier 1.6: elastic chaos lane (seeded worker_kill + obs-report) ==="

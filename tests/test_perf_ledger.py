@@ -1,0 +1,217 @@
+"""Banked perf ledger + CI perf gate (ISSUE 16): metric grammar, bank IO
+over both formats, trajectory/report rendering over the repo's REAL
+banks, and the gate's envelope math (no bench run — the measuring lane
+lives in ci.sh tier 0.75)."""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+from xgboost_tpu.observability import ledger
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+# ------------------------------------------------------- metric grammar
+
+def test_parse_metric_train():
+    f = ledger.parse_metric("train_time_1000kx50_500r_depth6_bin64")
+    assert f["family"] == "train_time" and f["shape"] == "1000kx50"
+    assert f["rows"] == 1_000_000 and f["cols"] == 50
+    assert f["rounds"] == 500 and f["depth"] == 6 and f["bin"] == 64
+    assert f["markers"] == [] and f["measured_rounds"] is None
+
+
+def test_parse_metric_markers_and_extrapolation():
+    f = ledger.parse_metric(
+        "train_time_1000kx50_500r_depth6_cpu_fallback_extrapolated_from_24r")
+    assert f["shape"] == "1000kx50" and f["rounds"] == 500
+    assert "cpu_fallback" in f["markers"]
+    assert "extrapolated_from_24r" in f["markers"]
+    assert f["measured_rounds"] == 24
+
+
+def test_parse_metric_predict_and_rejects():
+    f = ledger.parse_metric("predict_inplace_100kx50_10r")
+    assert f["family"] == "predict_inplace" and f["shape"] == "100kx50"
+    assert f["rounds"] == 10
+    assert ledger.parse_metric("train_time_failed") is None
+    assert ledger.parse_metric(None) is None
+    assert ledger.parse_metric("not_a_metric") is None
+
+
+# ---------------------------------------------------- validation + IO
+
+def _train_rec():
+    return {"metric": "train_time_100kx50_10r_depth6_bin64", "value": 12.5,
+            "unit": "s", "vs_baseline": 0.0,
+            "stages": {"grow": 10.0, "predict": 1.5},
+            "dispatch": {"level_hist": "native", "level_update": "xla"}}
+
+
+def test_validate_record():
+    assert ledger.validate_record(_train_rec(),
+                                  require_stages=True) == []
+    bad = dict(_train_rec(), value=float("nan"), unit="")
+    errs = ledger.validate_record(bad)
+    assert len(errs) == 2
+    no_stages = {k: v for k, v in _train_rec().items() if k != "stages"}
+    assert any("stages" in e for e in
+               ledger.validate_record(no_stages, require_stages=True))
+    assert ledger.validate_record([], require_stages=False) \
+        == ["record is not an object"]
+
+
+def test_write_bank_roundtrip(tmp_path):
+    predict = {"metric": "predict_inplace_100kx50_10r", "value": 1e6,
+               "unit": "rows/s"}
+    path = ledger.write_bank(str(tmp_path), 16, "python bench.py --bank r16",
+                             0, [_train_rec(), predict])
+    assert os.path.basename(path) == "BENCH_r16.json"
+    bank = ledger.load_bank_file(path)
+    assert bank["n"] == 16 and len(bank["records"]) == 2
+    doc = json.load(open(path))
+    assert doc["schema"] == ledger.SCHEMA
+    assert doc["parsed"] == doc["lines"][0]
+
+
+def test_write_bank_refuses_bad_records(tmp_path):
+    no_dispatch = {k: v for k, v in _train_rec().items() if k != "dispatch"}
+    with pytest.raises(ValueError, match="dispatch"):
+        ledger.write_bank(str(tmp_path), 16, "cmd", 0, [no_dispatch])
+    with pytest.raises(ValueError, match="nothing to bank"):
+        ledger.write_bank(str(tmp_path), 16, "cmd", 0, [])
+    assert not os.listdir(tmp_path)  # refusal leaves no partial file
+
+
+def test_legacy_bank_recovers_predict_from_tail(tmp_path):
+    """The pre-PR-16 hand-copied format: parsed = the train line, the
+    predict line only exists as raw text inside ``tail``."""
+    legacy = {
+        "n": 5, "cmd": "python bench.py", "rc": 0,
+        "tail": "noise\n"
+        + json.dumps({"metric": "train_time_1000kx50_500r_depth6",
+                      "value": 79.0, "unit": "s"}) + "\n"
+        + json.dumps({"metric": "predict_inplace_100kx50_10r",
+                      "value": 2e6, "unit": "rows/s"}) + "\n"
+        + "{torn json\n",
+        "parsed": {"metric": "train_time_1000kx50_500r_depth6",
+                   "value": 79.0, "unit": "s"},
+    }
+    p = tmp_path / "BENCH_r05.json"
+    p.write_text(json.dumps(legacy))
+    bank = ledger.load_bank_file(str(p))
+    assert bank["n"] == 5
+    metrics = [r["metric"] for r in bank["records"]]
+    # dedupe: parsed and its tail copy are ONE record
+    assert metrics == ["train_time_1000kx50_500r_depth6",
+                       "predict_inplace_100kx50_10r"]
+
+
+def test_failed_bank_loads_as_zero_records(tmp_path):
+    p = tmp_path / "BENCH_r01.json"
+    p.write_text(json.dumps({"n": 1, "rc": 1, "tail": "boom", "parsed": None}))
+    bank = ledger.load_bank_file(str(p))
+    assert bank["records"] == [] and bank["n"] == 1
+
+
+def test_load_ledger_over_real_repo_banks():
+    """The repo's actual BENCH_r*.json history must load: early failed
+    banks (r01-r04) as zero records, r15 with a train record carrying
+    stages + a predict record recovered from its tail."""
+    banks = ledger.load_ledger(REPO)
+    assert len(banks) >= 5
+    assert [b["n"] for b in banks] == sorted(b["n"] for b in banks)
+    by_n = {b["n"]: b for b in banks}
+    assert 15 in by_n
+    fams = {ledger.parse_metric(r["metric"])["family"]
+            for r in by_n[15]["records"]}
+    assert fams == {"train_time", "predict_inplace"}
+    train = next(r for r in by_n[15]["records"]
+                 if r["metric"].startswith("train_time"))
+    assert isinstance(train.get("stages"), dict) and train["stages"]
+
+
+def test_unreadable_bank_skipped_not_fatal(tmp_path, capsys):
+    (tmp_path / "BENCH_r03.json").write_text("{not json")
+    (tmp_path / "BENCH_r07.json").write_text(json.dumps(
+        {"n": 7, "rc": 0, "lines": [_train_rec()]}))
+    banks = ledger.load_ledger(str(tmp_path))
+    assert [b["n"] for b in banks] == [7]
+    assert "unreadable bank" in capsys.readouterr().err
+
+
+# -------------------------------------------------- trajectory + report
+
+def test_gaps_rendering():
+    assert ledger._gaps([1, 2, 5, 15]) == "r03-r04, r06-r14"
+    assert ledger._gaps([3]) == ""
+    assert ledger._gaps([3, 4]) == ""
+
+
+def test_trajectory_rounds_per_s_and_best_excludes_failed(tmp_path):
+    ledger.write_bank(str(tmp_path), 10, "c", 0, [_train_rec()])
+    worse = dict(_train_rec(), value=50.0,
+                 metric="train_time_100kx50_10r_depth6_bin64_quality_failed")
+    ledger.write_bank(str(tmp_path), 11, "c", 0, [worse])
+    banks = ledger.load_ledger(str(tmp_path))
+    traj = ledger.trajectory(banks)
+    pts = traj[("train_time", "100kx50")]
+    assert [p["n"] for p in pts] == [10, 11]
+    assert pts[0]["rounds_per_s"] == pytest.approx(10 / 12.5)
+    best = ledger._best(pts)
+    assert best is pts[0], "a quality_failed point must never be best"
+    txt = ledger.format_report(banks, published={"hist_1000kx50":
+                                                 {"seconds": 36.01}})
+    assert "train_time @ 100kx50" in txt
+    assert "best" in txt and "[quality_failed]" in txt
+    assert "stages: grow 10.00s" in txt
+    assert "dispatch: level_hist=native" in txt
+    assert "published reference anchors" in txt and "36.01" in txt
+
+
+def test_perf_report_main_over_repo(capsys):
+    assert ledger.main(["--root", REPO]) == 0
+    out = capsys.readouterr().out
+    assert "== perf ledger:" in out
+    assert "r15" in out and "r/s" in out
+
+
+def test_perf_report_main_empty_dir(tmp_path, capsys):
+    assert ledger.main(["--root", str(tmp_path)]) == 1
+    assert "no BENCH_r" in capsys.readouterr().err
+    assert ledger.main(["--bogus"]) == 1
+
+
+# ------------------------------------------------------------ perf gate
+
+def _gate():
+    spec = importlib.util.spec_from_file_location(
+        "perf_gate", os.path.join(REPO, "scripts", "perf_gate.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_gate_floor_math():
+    gate = _gate()
+    assert gate.floor_of({"rounds_per_s": 10.0, "noise_band": 0.2}) \
+        == pytest.approx(8.0)
+    # default band applies when the envelope predates the field
+    assert gate.floor_of({"rounds_per_s": 100.0}) \
+        == pytest.approx(100.0 * (1 - gate.NOISE_BAND))
+
+
+def test_gate_checked_in_envelope_is_sane():
+    """The envelope ci.sh tier 0.75 gates against must load, carry the
+    pinned workload shape, and yield a positive floor below the
+    reference rounds/s."""
+    gate = _gate()
+    env = json.load(open(os.path.join(REPO, "scripts",
+                                      "perf_envelope.json")))
+    assert env["schema"] == "perf-envelope-v1"
+    assert env["workload"] == gate.WORKLOAD
+    floor = gate.floor_of(env)
+    assert 0 < floor < env["rounds_per_s"]

@@ -5,6 +5,8 @@ Public surface (see ``core.py`` for the design notes):
 - :func:`resolve` / :class:`Ctx` / :class:`Decision` — the lookup.
 - :func:`register` — add an impl (a GPU backend is a table entry).
 - :func:`pinned_off` / :func:`degraded` — compat/admission reads.
+- :func:`invoke` / :func:`set_invoke_hook` — the invocation seam the
+  kernel profiler brackets (``observability/kernelprof.py``).
 - :func:`explain` / :func:`last_decisions` / :func:`table_snapshot` —
   the report CLI, BENCH sidecar and flight-black-box surfaces.
 """
@@ -17,18 +19,21 @@ from .core import (  # noqa: F401
     LEGACY_ENVS,
     degraded,
     explain,
+    invoke,
     last_decisions,
     op_names,
     pinned_off,
     register,
     reset,
     resolve,
+    set_invoke_hook,
     set_report_ctx,
     table_snapshot,
 )
 
 __all__ = [
     "Ctx", "Decision", "DispatchError", "KernelImpl", "LEGACY_ENVS",
-    "degraded", "explain", "last_decisions", "op_names", "pinned_off",
-    "register", "reset", "resolve", "set_report_ctx", "table_snapshot",
+    "degraded", "explain", "invoke", "last_decisions", "op_names",
+    "pinned_off", "register", "reset", "resolve", "set_invoke_hook",
+    "set_report_ctx", "table_snapshot",
 ]
