@@ -89,10 +89,20 @@ def test_grow_detail_record_on_sampled_rounds_only(monkeypatch):
     assert set(rounds) == {0, 1, 2, 3}
     assert not any("grow_detail" in rounds[i] for i in (0, 2)), \
         "unsampled rounds must not carry grow_detail"
+    from xgboost_tpu import dispatch
+
+    expect_route = ("tree_grow"
+                    if dispatch.resolve("tree_grow").impl == "native"
+                    else "level")
     for i in (1, 3):
         gd = rounds[i]["grow_detail"]
         assert gd["round"] == i and gd["driver"] == kernelprof.DRIVER
         assert gd["trees"] == 1
+        # ISSUE 17: the record says which production route the mirror
+        # replayed; one-dispatch rounds replay per-level with the
+        # sibling-sub FFI entry (default sibling_sub=on)
+        assert gd["route"] == expect_route
+        assert gd["sibling_sub"] is (expect_route == "tree_grow")
         ops = gd["ops"]
         # depth-4 unrolled mirror: prep + 4x(hist+update) + partition +
         # finalize + leaf_delta = 12 brackets, one sync each
@@ -185,17 +195,18 @@ def test_unprofiled_overhead_at_most_2pct_of_round():
 
 # ----------------------------------------------------------- grow-report
 
-def _fake_record(round_idx=3):
+def _fake_record(round_idx=3, route="tree_grow", hist_wall=0.02):
     return {
         "round": round_idx, "driver": kernelprof.DRIVER, "trees": 1,
-        "host_syncs": 3, "sum_s": 0.03, "gap_s": 0.001,
+        "route": route, "sibling_sub": route == "tree_grow",
+        "host_syncs": 3, "sum_s": 0.01 + hist_wall, "gap_s": 0.001,
         "ops": [
             {"op": "prep", "depth": -1, "impl": "xla", "count": 1,
              "wall_s": 0.01, "host_s": 0.009, "inflight_s": 0.001,
              "gap_s": 0.0},
             {"op": "level_hist", "depth": 0, "impl": "native", "count": 1,
-             "wall_s": 0.02, "host_s": 0.019, "inflight_s": 0.001,
-             "gap_s": 0.001},
+             "wall_s": hist_wall, "host_s": hist_wall - 0.001,
+             "inflight_s": 0.001, "gap_s": 0.001},
         ],
     }
 
@@ -206,6 +217,12 @@ def test_format_grow_detail_renders_table():
     assert "level_hist" in txt and "native" in txt
     assert "prep" in txt
     assert "substages = 93.8%" in txt, txt
+    # ISSUE 17: one-dispatch rounds advertise the replayed route
+    assert "route=tree_grow (sibling-sub replay)" in txt
+    # pre-ISSUE-17 records (no route field) still render
+    legacy = _fake_record()
+    del legacy["route"], legacy["sibling_sub"]
+    assert "route=" not in kernelprof.format_grow_detail(legacy)
 
 
 def test_grow_report_main_over_torn_sink(tmp_path, capsys):
@@ -232,3 +249,34 @@ def test_grow_report_main_over_torn_sink(tmp_path, capsys):
     assert kernelprof.main([str(empty)]) == 1
     err = capsys.readouterr().err
     assert "XGBTPU_KERNEL_PROF" in err
+
+
+def test_grow_report_diff(tmp_path, capsys):
+    """grow-report --diff A B: per-depth x per-op table across two run
+    dirs with a delta column (ISSUE 17) — the before/after view for a
+    kernel change, e.g. sibling-sub on vs off."""
+
+    def _sink(name, route, hist_wall):
+        d = tmp_path / name / "obs" / "rank0"
+        d.mkdir(parents=True)
+        rec = {"t": "round", "round": 3, "wall_s": 0.04,
+               "stages": {"grow": 0.032},
+               "grow_detail": _fake_record(route=route,
+                                           hist_wall=hist_wall)}
+        with open(d / "flight.jsonl", "w") as f:
+            f.write(json.dumps({"t": "meta", "rank": 0}) + "\n")
+            f.write(json.dumps(rec) + "\n")
+        return str(tmp_path / name)
+
+    a = _sink("a", "level", 0.02)
+    b = _sink("b", "tree_grow", 0.005)
+    assert kernelprof.main(["--diff", a, b]) == 0
+    out = capsys.readouterr().out
+    assert "grow detail diff:" in out
+    assert "delta" in out and "level_hist" in out
+    assert "-15.000ms" in out, out  # 5ms - 20ms on the hist bucket
+    # --round filtering applies to both sides; a side with no sampled
+    # records exits 1 with the arming hint
+    assert kernelprof.main(["--diff", a, b, "--round", "9"]) == 1
+    assert "XGBTPU_KERNEL_PROF" in capsys.readouterr().err
+    assert kernelprof.main(["--diff", a]) == 1  # needs exactly two sides

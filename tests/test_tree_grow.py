@@ -1,0 +1,200 @@
+"""The whole-tree native grow kernel (ISSUE 17): sibling-subtraction
+exactness on count-valued data, the e2e model-equality matrix across
+{sibling_sub on/off} x {tree_grow/per-level} routes, the bit-identity
+kill-switch pin, and the dispatch-table rows."""
+
+import numpy as np
+import pytest
+
+import xgboost_tpu as xgb
+from xgboost_tpu import dispatch
+from xgboost_tpu.tree import tree_kernel
+
+def _ffi_ready() -> bool:
+    from xgboost_tpu.tree import hist_kernel
+
+    return tree_kernel.tree_ffi_ready() and hist_kernel._ensure_ffi()
+
+
+pytestmark = pytest.mark.skipif(
+    not _ffi_ready(),
+    reason="native toolchain / FFI headers unavailable")
+
+
+@pytest.fixture(autouse=True)
+def _fresh_traces():
+    """Route decisions are captured at trace time inside the jitted
+    drivers; tests here flip env pins, so every test starts AND ends
+    with a clean jit cache to keep pinned routes from leaking."""
+    import jax
+
+    jax.clear_caches()
+    yield
+    jax.clear_caches()
+
+
+def _data(n=4000, F=12, seed=7, missing=0.1):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, F).astype(np.float32)
+    X[rng.rand(n, F) < missing] = np.nan
+    y = ((np.nan_to_num(X) @ rng.randn(F)) > 0).astype(np.float32)
+    return X, y
+
+
+# ------------------------------------------------- subtraction exactness
+
+def test_parent_minus_child_exact_on_counts():
+    """The sibling-subtraction contract at its sharpest: with integer-
+    valued g/h (exactly representable, sums < 2^24) the derived sibling
+    parent - built_child equals the directly-built histogram BIT FOR
+    BIT — f32 subtraction of exact integers is exact."""
+    import jax.numpy as jnp
+
+    from xgboost_tpu.tree.hist_kernel import fused_level_native
+
+    rng = np.random.RandomState(3)
+    n, F, B = 5000, 8, 16
+    bins = jnp.asarray(rng.randint(0, B + 1, (n, F)).astype(np.uint8))
+    gh = jnp.asarray(np.stack(
+        [rng.randint(-3, 4, n), rng.randint(1, 5, n)], axis=-1)
+        .astype(np.float32))
+    pos = jnp.zeros((n, 1), jnp.int32)
+
+    # level 0: root histogram (the parent of the first sibling pair)
+    _, hist0 = fused_level_native(bins, pos, gh, jnp.zeros((1, 4),
+                                  jnp.float32), K=1, Kp=0, B=B, d=0)
+
+    # split the root, then build level 1 both ways from the same inputs
+    ptab = jnp.asarray(np.array([[1.0, 2.0, B // 2, 1.0]], np.float32))
+    pos_d, hist_direct = fused_level_native(
+        bins, pos, gh, ptab, K=2, Kp=1, B=B, d=1)
+    pos_s, hist_sub = tree_kernel.fused_level_sub_native(
+        bins, pos, gh, ptab, hist0, K=2, Kp=1, B=B, d=1)
+
+    assert np.array_equal(np.asarray(pos_d), np.asarray(pos_s))
+    assert np.array_equal(np.asarray(hist_direct), np.asarray(hist_sub)), \
+        "derived sibling (parent - child) diverged from the direct build"
+
+
+def test_unsplit_pair_stays_zero():
+    """A level-0 node that does NOT split routes every row to the spill
+    slot; both level-1 children are empty and the sub path must leave
+    their cells zero (= the direct build of zero rows), not garbage."""
+    import jax.numpy as jnp
+
+    from xgboost_tpu.tree.hist_kernel import fused_level_native
+
+    rng = np.random.RandomState(4)
+    n, F, B = 1000, 4, 8
+    bins = jnp.asarray(rng.randint(0, B + 1, (n, F)).astype(np.uint8))
+    gh = jnp.asarray(np.stack(
+        [rng.randint(-2, 3, n), rng.randint(1, 3, n)], axis=-1)
+        .astype(np.float32))
+    pos = jnp.zeros((n, 1), jnp.int32)
+    _, hist0 = fused_level_native(bins, pos, gh, jnp.zeros((1, 4),
+                                  jnp.float32), K=1, Kp=0, B=B, d=0)
+    ptab = jnp.zeros((1, 4), jnp.float32)  # is_split = 0
+    pos_d, hist_direct = fused_level_native(
+        bins, pos, gh, ptab, K=2, Kp=1, B=B, d=1)
+    pos_s, hist_sub = tree_kernel.fused_level_sub_native(
+        bins, pos, gh, ptab, hist0, K=2, Kp=1, B=B, d=1)
+    assert not np.asarray(hist_sub).any()
+    assert np.array_equal(np.asarray(pos_d), np.asarray(pos_s))
+    assert np.array_equal(np.asarray(hist_direct), np.asarray(hist_sub))
+
+
+# ------------------------------------------------ e2e route/sub matrix
+
+_PARAMS = {"objective": "binary:logistic", "max_depth": 4, "max_bin": 32,
+           "verbosity": 0}
+
+
+def _train_raw_and_preds(X, y, rounds=4):
+    d = xgb.DMatrix(X, label=y)
+    bst = xgb.train(_PARAMS, d, rounds, verbose_eval=False)
+    return bst.save_raw(), np.asarray(bst.predict(xgb.DMatrix(X[:800])))
+
+
+def test_route_matrix_model_equality(monkeypatch):
+    """The acceptance matrix at depth 4: the whole-tree kernel with
+    subtraction OFF is byte-identical to the per-level path (the
+    ``XGBTPU_SIBLING_SUB=0`` pin's contract), and subtraction ON keeps
+    the same trees up to the f32 reassociation of derived histogram
+    cells (predictions agree to 1e-5)."""
+    import jax
+
+    X, y = _data()
+    assert dispatch.resolve("tree_grow").impl == "native"
+    raw_sub_on, pred_sub_on = _train_raw_and_preds(X, y)
+
+    monkeypatch.setenv("XGBTPU_DISPATCH", "sibling_sub=off")
+    jax.clear_caches()
+    raw_sub_off, pred_sub_off = _train_raw_and_preds(X, y)
+
+    monkeypatch.setenv("XGBTPU_DISPATCH", "tree_grow=level")
+    jax.clear_caches()
+    raw_level, pred_level = _train_raw_and_preds(X, y)
+
+    monkeypatch.setenv("XGBTPU_DISPATCH", "tree_grow=level,sibling_sub=off")
+    jax.clear_caches()
+    raw_level_off, _ = _train_raw_and_preds(X, y)
+
+    # sub off == per-level, BITWISE (and sibling_sub is a no-op there)
+    assert raw_sub_off == raw_level, \
+        "tree_grow(sub=off) diverged from the per-level path"
+    assert raw_level_off == raw_level
+    # sub on: same model within cross-program float tolerance
+    np.testing.assert_allclose(pred_sub_on, pred_level, rtol=1e-5,
+                               atol=1e-5)
+    np.testing.assert_allclose(pred_sub_on, pred_sub_off, rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_legacy_sibling_sub_kill_switch(monkeypatch):
+    """XGBTPU_SIBLING_SUB=0 maps to the sibling_sub=off pin (deprecation
+    shim) and pins the kernel byte-identical to the per-level route."""
+    import jax
+
+    X, y = _data(n=1500, F=6)
+    monkeypatch.setenv("XGBTPU_SIBLING_SUB", "0")
+    jax.clear_caches()
+    assert dispatch.resolve("sibling_sub").impl == "off"
+    raw_kernel, _ = _train_raw_and_preds(X, y, rounds=2)
+    monkeypatch.setenv("XGBTPU_DISPATCH", "tree_grow=level")
+    jax.clear_caches()
+    raw_level, _ = _train_raw_and_preds(X, y, rounds=2)
+    assert raw_kernel == raw_level
+
+
+# ------------------------------------------------------- dispatch table
+
+def test_dispatch_rows_and_default_route():
+    """The registry rows the docs promise: ``tree_grow`` resolves native
+    on CPU (report ctx = the bench shape), ``sibling_sub`` defaults on,
+    and both are rows in dispatch-report (the tier-0.5 CI artifact)."""
+    assert dispatch.resolve("tree_grow").impl == "native"
+    assert dispatch.resolve("sibling_sub").impl == "on"
+    from xgboost_tpu.cli import cli_main
+    assert cli_main(["dispatch-report"]) == 0
+
+
+def test_out_of_envelope_configs_keep_level_route():
+    """Features whose eval the C++ port does NOT replicate stay on the
+    per-level path: max_delta_step > 0 (the FMA-contraction hazard —
+    tree_build.cpp), per-level/per-node colsample draws, monotone and
+    interaction constraints, categorical tables."""
+    from xgboost_tpu.dispatch import Ctx
+
+    base = dict(platform="cpu", pallas=False, interpret=False,
+                sharded=False, has_cats=False, bins_dtype="uint8",
+                depth=6, monotone=False, interaction=False,
+                colsample_level=1.0, colsample_node=1.0,
+                max_delta_step=0.0)
+    assert dispatch.resolve("tree_grow", Ctx(**base)).impl == "native"
+    for twist in ({"max_delta_step": 0.7}, {"colsample_level": 0.5},
+                  {"colsample_node": 0.5}, {"monotone": True},
+                  {"interaction": True}, {"has_cats": True},
+                  {"sharded": True}, {"pallas": True},
+                  {"platform": "tpu"}, {"bins_dtype": "int32"}):
+        ctx = Ctx(**{**base, **twist})
+        assert dispatch.resolve("tree_grow", ctx).impl == "level", twist

@@ -68,6 +68,7 @@ LEGACY_ENVS: Tuple[Tuple[str, str, Tuple[Tuple[str, str], ...]], ...] = (
                                  ("level_partition", "!native"))),
     ("XGBTPU_DEPTH_SCAN", "0", (("depth_scan", "unrolled"),)),
     ("XGBTPU_NATIVE_SERVING", "0", (("predict_walk", "!native"),)),
+    ("XGBTPU_SIBLING_SUB", "0", (("sibling_sub", "off"),)),
 )
 
 _DISPATCH_ENV = "XGBTPU_DISPATCH"

@@ -13,6 +13,8 @@ Op reference (see docs/perf.md, "Choosing a kernel"):
 ====================  =========================================  =============
 op                    implementations (preference order)         capability
 ====================  =========================================  =============
+``tree_grow``         native (CPU, whole-round kernel) > level   —
+``sibling_sub``       on > off (histogram subtraction trick)     —
 ``level_hist``        pallas > native (CPU) > xla                —
 ``level_partition``   native (CPU) > xla                         —
 ``level_update``      xla (single impl: shared split eval)       —
@@ -54,6 +56,59 @@ def _native_level_available(ctx: Ctx) -> bool:
     from ..tree import hist_kernel
 
     return hist_kernel._ensure_ffi()
+
+
+def _tree_grow_native_applicable(ctx: Ctx) -> bool:
+    """The whole-tree kernel's trace-time envelope (ISSUE 17 tentpole):
+    everything the per-level native kernel needs, PLUS the features whose
+    eval the C++ port replicates bitwise. Per-level colsample draws
+    (bylevel/bynode < 1) stay on the per-level path — their PRNG folds
+    cannot be mirrored in C++ — as does max_delta_step > 0, whose gain
+    expression XLA:CPU contracts into an FMA the kernel must not emit
+    (see tree_build.cpp). Monotone/interaction constraints and
+    categorical tables keep the XLA evaluator."""
+    return (ctx.get("platform") == "cpu"
+            and not ctx.get("interpret", False)
+            and not ctx.get("sharded", False)
+            and not ctx.get("pallas", False)
+            and not ctx.get("has_cats", False)
+            and ctx.get("bins_dtype") in _NARROW_BINS
+            and int(ctx.get("depth", 0)) >= 1
+            and not ctx.get("monotone", False)
+            and not ctx.get("interaction", False)
+            and float(ctx.get("colsample_level", 1.0)) >= 1.0
+            and float(ctx.get("colsample_node", 1.0)) >= 1.0
+            and float(ctx.get("max_delta_step", 0.0)) == 0.0)
+
+
+def _tree_grow_native_available(ctx: Ctx) -> bool:
+    from ..tree import tree_kernel
+
+    return tree_kernel.tree_ffi_ready()
+
+
+# The whole-round grow kernel (native/tree_build.cpp): ONE custom call per
+# boosting round on CPU; the ``level`` impl is the existing per-level path
+# (depth scan / unrolled / pallas / mesh), which every other platform and
+# every out-of-envelope config keeps.
+register("tree_grow", "native", pref=(("cpu", 0), ("*", 2)),
+         applicable=_tree_grow_native_applicable,
+         available=_tree_grow_native_available)
+register("tree_grow", "level", pref=(("*", 1),))
+set_report_ctx("tree_grow", lambda: Ctx(
+    platform=_platform(), pallas=_platform() == "tpu", interpret=False,
+    sharded=False, has_cats=False, bins_dtype="uint8", depth=6,
+    monotone=False, interaction=False, colsample_level=1.0,
+    colsample_node=1.0, max_delta_step=0.0))
+
+
+# Sibling subtraction inside the whole-tree kernel: build only the smaller
+# child's histogram, derive the other as parent - child. ``off`` pins the
+# kernel bit-identical to the per-level native path (the legacy
+# ``XGBTPU_SIBLING_SUB=0`` kill switch maps here).
+register("sibling_sub", "on", pref=(("*", 0),))
+register("sibling_sub", "off", pref=(("*", 1),))
+set_report_ctx("sibling_sub", lambda: Ctx(platform=_platform()))
 
 
 def _pallas_level_applicable(ctx: Ctx) -> bool:

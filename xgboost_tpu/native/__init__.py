@@ -310,6 +310,49 @@ def get_hist_lib() -> Optional[ctypes.CDLL]:
         return _hb_lib
 
 
+_TB_SRC = os.path.join(_HERE, "tree_build.cpp")
+_TB_LIB = os.path.join(_HERE, "libtreebuild.so")
+_tb_lib: Optional[ctypes.CDLL] = None
+_tb_tried = False
+
+
+def get_tree_lib() -> Optional[ctypes.CDLL]:
+    """Load (building on demand) the whole-tree native grow kernel
+    (``tree_build.cpp`` — one custom call per boosting round; the
+    ``tree_grow`` dispatch op resolves to it on CPU and
+    ``tree/tree_kernel.py`` registers the exported ``XgbtpuTreeGrow`` /
+    ``XgbtpuHbLevelSub`` handler symbols as XLA FFI targets). Built with
+    ``-ffp-contract=off`` — the split-eval port is bit-identical to the
+    XLA ``_level_update`` only without FMA contraction — and with OpenMP
+    when the toolchain has it (falls back to single-threaded). None when
+    the toolchain or the jaxlib FFI headers are unavailable (callers keep
+    the per-level path)."""
+    global _tb_lib, _tb_tried
+    with _lock:
+        if _tb_lib is not None or _tb_tried:
+            return _tb_lib
+        _tb_tried = True
+        try:
+            from jax.extend import ffi as _jffi
+
+            inc = _jffi.include_dir()
+        except Exception:
+            return None
+        lp = _lib_variant(_TB_LIB)
+        flags = ["-O3", "-march=native", "-std=c++17",
+                 "-ffp-contract=off", f"-I{inc}"]
+        ok = _compile(_TB_SRC, lp, flags + ["-fopenmp"])
+        if not ok:  # toolchains without OpenMP: single-threaded kernel
+            ok = _compile(_TB_SRC, lp, flags)
+        if not ok:
+            return None
+        try:
+            _tb_lib = ctypes.CDLL(lp)
+        except OSError:
+            return None
+        return _tb_lib
+
+
 _SB_SRC = os.path.join(_HERE, "sketch_bin.cpp")
 _SB_LIB = os.path.join(_HERE, "libsketchbin.so")
 _sb_lib: Optional[ctypes.CDLL] = None

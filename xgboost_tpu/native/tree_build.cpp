@@ -1,0 +1,569 @@
+// Whole-tree native grow kernel for the CPU training path, registered as
+// XLA FFI custom calls.
+//
+// `hist_build.cpp` moved the level histogram + partition into one native
+// call per level, but the round still pays ~2 dispatches per depth
+// (`fused_level` + `_level_update_jit`) plus the XLA glue between them.
+// This kernel runs the ENTIRE depth loop of one boosting round in a
+// single custom call (`XgbtpuTreeGrow`): per-level partition, histogram
+// build, split evaluation, and heap/node update, returning the finalized
+// heap arrays that `_finalize_jit` consumes — one host round-trip per
+// round instead of ~2 per level.
+//
+// Bit-identity contract (the same methodology hist_build.cpp pinned):
+//  * Histogram accumulation preserves the per-cell order of the XLA
+//    segment_sum (rows ascending per cell). The cache-blocked loop below
+//    only re-tiles the FEATURE axis — per-cell row order is unchanged, so
+//    blocking is bit-transparent.
+//  * Split evaluation replicates `_level_update` exactly: the repo's
+//    eval uses `seq_cumsum` (strict left-to-right f32 association), which
+//    a sequential C loop reproduces; gain/weight formulas are ported
+//    term-for-term from `tree/param.py` and validated bitwise against the
+//    jitted `_level_update` (see tests). Two codegen hazards are handled
+//    explicitly: this file must compile with -ffp-contract=off (gcc -O3
+//    defaults to contract=fast and would fuse mul+add into FMA), and the
+//    max_delta_step>0 gain path is NOT claimed bit-identical (XLA:CPU
+//    contracts `2*G*w + denom*w*w` into an FMA there) — the dispatcher
+//    only routes max_delta_step==0 configs to this kernel.
+//  * Sibling subtraction (attr `sibling_sub`): at depth >= 1 build only
+//    the child with fewer rows and derive the other as parent - child
+//    (exact on count-valued data; model-equal otherwise). When one child
+//    is empty, parent - 0 reproduces the direct build bit-for-bit, so the
+//    off switch (XGBTPU_SIBLING_SUB=0) pins the whole kernel bit-identical
+//    to the per-level native path.
+//
+// `XgbtpuHbLevelSub` exposes ONE level of the same machinery (partition +
+// subtraction histogram) for the kernelprof mirror: sampled rounds replay
+// the round per-level for attribution, and because the mirror kernel
+// shares these exact core loops, its histograms match the in-kernel ones
+// bit-for-bit by construction.
+//
+// Blocking parameters: feature blocks are sized so one block's histogram
+// slab ([fb, 2K, B] f32) fits the kHistL2Budget bytes (256 KiB — a
+// conservative 1-core L2 share); rows stream once per block. OpenMP
+// parallelism follows serving_walk.cpp: static row/node splits guarded by
+// a minimum size so small batches skip team spawn, and every parallel
+// region writes disjoint slabs (feature blocks / nodes / rows), keeping
+// results independent of thread count.
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+#include "xla/ffi/api/ffi.h"
+
+namespace ffi = xla::ffi;
+
+namespace {
+
+constexpr int64_t kHistL2Budget = 256 * 1024;  // bytes per feature block
+constexpr float kRtEps = 1e-6f;                // param.py RT_EPS
+
+struct SplitP {
+    float lam, alpha, mds, mcw;
+};
+
+// ---- param.py ports (f32 term-for-term; see tree/param.py) -------------
+
+inline float thresh_l1(float g, float a) {
+    if (a == 0.0f) return g;
+    float t = std::fabs(g) - a;
+    if (t < 0.0f) t = 0.0f;  // NaN compares false and passes through
+    const float s = (g > 0.0f) ? 1.0f : ((g < 0.0f) ? -1.0f : g);
+    return s * t;
+}
+
+inline float calc_weight_c(float G, float H, const SplitP& p) {
+    const float denom = H + p.lam;
+    float w = 0.0f;
+    if (denom > 0.0f) {
+        const float t = thresh_l1(G, p.alpha);
+        const float d2 = (denom < 1e-38f) ? 1e-38f : denom;
+        w = -t / d2;
+    }
+    if (p.mds > 0.0f) {
+        if (w < -p.mds) w = -p.mds;
+        if (w > p.mds) w = p.mds;  // NaN stays NaN, like jnp.clip
+    }
+    if (H < p.mcw || H <= 0.0f) return 0.0f;
+    return w;
+}
+
+inline float calc_gain_c(float G, float H, const SplitP& p) {
+    const float denom = H + p.lam;
+    float g = 0.0f;
+    if (p.mds == 0.0f) {
+        if (denom > 0.0f) {
+            const float t = thresh_l1(G, p.alpha);
+            const float d2 = (denom < 1e-38f) ? 1e-38f : denom;
+            g = (t * t) / d2;
+        }
+    } else {
+        // Not dispatched for bit-identity (XLA contracts this into FMA);
+        // kept faithful to the source association for manual pins.
+        const float w = calc_weight_c(G, H, p);
+        g = -((2.0f * G) * w + (denom * w) * w);
+    }
+    if (H < p.mcw) return 0.0f;
+    return g;
+}
+
+// ---- shared core loops -------------------------------------------------
+
+// Route rows through a level's decisions (typed arrays, one entry per
+// previous-level node). Semantics mirror hist_build.cpp partition_loop:
+// missing (bv >= B) goes the default direction, bin compare is <=.
+template <typename BinT>
+void partition_rows(const BinT* bins, int32_t* pos, const uint8_t* isplit,
+                    const int32_t* feat, const int32_t* bin,
+                    const uint8_t* dleft, int64_t n, int64_t F, int64_t B,
+                    int64_t Kp, int64_t poff) {
+#ifdef _OPENMP
+#pragma omp parallel for schedule(static) if (n >= 8192)
+#endif
+    for (int64_t i = 0; i < n; ++i) {
+        const int32_t pcur = pos[i];
+        const int64_t lp = (int64_t)pcur - poff;
+        if (lp < 0 || lp >= Kp) continue;
+        if (!isplit[lp]) continue;
+        const int64_t f = feat[lp];
+        const int64_t bv = (int64_t)bins[i * F + f];
+        const bool left = (bv >= B) ? (dleft[lp] != 0) : (bv <= bin[lp]);
+        pos[i] = (int32_t)(2 * pcur + (left ? 1 : 2));
+    }
+}
+
+void count_rows(const int32_t* pos, int64_t n, int64_t off, int64_t K,
+                int64_t* counts) {
+    std::fill(counts, counts + K, (int64_t)0);
+    for (int64_t i = 0; i < n; ++i) {
+        const int64_t s = (int64_t)pos[i] - off;
+        if (s >= 0 && s < K) ++counts[s];
+    }
+}
+
+// Accumulate (g, h) into hist [F, 2K, B] for rows landing in this level's
+// slots (optionally only slots with build_mask set). Cache-blocked over
+// features: each block's hist slab stays L2-resident while rows stream.
+// Per-cell accumulation order is rows ascending — identical to
+// hist_build.cpp level_loop — for any block size or thread count, because
+// blocks/threads own disjoint feature slabs.
+template <typename BinT>
+void accumulate_level(const BinT* bins, const int32_t* pos, const float* gh,
+                      int64_t n, int64_t F, int64_t B, int64_t K, int64_t off,
+                      const uint8_t* build_mask, float* hist) {
+    const int64_t feat_stride = 2 * K * B;
+    int64_t fb = kHistL2Budget / (int64_t)(2 * K * B * sizeof(float));
+    if (fb < 1) fb = 1;
+    if (fb > F) fb = F;
+    const int64_t nblk = (F + fb - 1) / fb;
+#ifdef _OPENMP
+#pragma omp parallel for schedule(dynamic, 1) if (nblk > 1 && n >= 8192)
+#endif
+    for (int64_t blk = 0; blk < nblk; ++blk) {
+        const int64_t f0 = blk * fb;
+        const int64_t f1 = std::min<int64_t>(F, f0 + fb);
+        for (int64_t i = 0; i < n; ++i) {
+            const int64_t s = (int64_t)pos[i] - off;
+            if (s < 0 || s >= K) continue;
+            if (build_mask && !build_mask[s]) continue;
+            const float g = gh[2 * i], h = gh[2 * i + 1];
+            const BinT* br = bins + i * F;
+            float* gbase = hist + s * B;
+            for (int64_t f = f0; f < f1; ++f) {
+                const int64_t bv = br[f];
+                if (bv >= B) continue;  // missing: recovered as total - sum
+                float* cell = gbase + f * feat_stride + bv;
+                cell[0] += g;
+                cell[K * B] += h;
+            }
+        }
+    }
+}
+
+// Mark, per sibling pair, the child with fewer rows as the one to build
+// directly. Pairs with no rows at all stay unbuilt (their cells stay 0,
+// matching a direct build of zero rows).
+void plan_siblings(const int64_t* counts, int64_t Kp, uint8_t* build_mask) {
+    for (int64_t j = 0; j < Kp; ++j) {
+        const int64_t sl = 2 * j, sr = 2 * j + 1;
+        build_mask[sl] = 0;
+        build_mask[sr] = 0;
+        if (counts[sl] + counts[sr] == 0) continue;
+        build_mask[counts[sl] <= counts[sr] ? sl : sr] = 1;
+    }
+}
+
+// Derive each unbuilt sibling as parent - built (f32 subtraction per
+// cell). prev is the previous level's hist [F, 2Kp, B]; cur is this
+// level's [F, 2K, B] with the built children already accumulated.
+void derive_siblings(const float* prev, float* cur, int64_t F, int64_t B,
+                     int64_t K, int64_t Kp, const int64_t* counts) {
+    const int64_t fs_cur = 2 * K * B, fs_prev = 2 * Kp * B;
+#ifdef _OPENMP
+#pragma omp parallel for schedule(static) if (F >= 8)
+#endif
+    for (int64_t f = 0; f < F; ++f) {
+        for (int64_t j = 0; j < Kp; ++j) {
+            const int64_t sl = 2 * j, sr = 2 * j + 1;
+            if (counts[sl] + counts[sr] == 0) continue;
+            const int64_t built = counts[sl] <= counts[sr] ? sl : sr;
+            const int64_t other = sl + sr - built;
+            const float* pg = prev + f * fs_prev + j * B;
+            const float* ph = pg + Kp * B;
+            const float* bg = cur + f * fs_cur + built * B;
+            const float* bh = bg + K * B;
+            float* og = cur + f * fs_cur + other * B;
+            float* oh = og + K * B;
+            for (int64_t b = 0; b < B; ++b) {
+                og[b] = pg[b] - bg[b];
+                oh[b] = ph[b] - bh[b];
+            }
+        }
+    }
+}
+
+// Split evaluation for one level — a sequential-association port of
+// `_level_update` (grow_fused.py). Scans candidates dir-major then
+// feature then bin with first-max/first-NaN argmax semantics matching
+// jnp.argmax on the [K, 2*F*B] score tensor. Writes this level's slot
+// decisions unconditionally and child stats only for can_split nodes
+// (the XLA path's mode="drop" scatter).
+void eval_level(const float* hist, const float* cuts, const int32_t* fmask,
+                int64_t F, int64_t B, int64_t K, int64_t off,
+                const SplitP& p, bool* is_split, int32_t* feature,
+                int32_t* split_bin, float* split_cond, bool* default_left,
+                float* node_g, float* node_h, float* node_w, float* loss_chg,
+                int64_t max_nodes) {
+    const int64_t feat_stride = 2 * K * B;
+#ifdef _OPENMP
+#pragma omp parallel for schedule(static) if (K >= 8)
+#endif
+    for (int64_t k = 0; k < K; ++k) {
+        std::vector<float> GL((size_t)(F * B)), HL((size_t)(F * B));
+        std::vector<float> gm((size_t)F), hm((size_t)F);
+        const float Gtot = node_g[off + k], Htot = node_h[off + k];
+        for (int64_t f = 0; f < F; ++f) {
+            const float* hg = hist + f * feat_stride + k * B;
+            const float* hh = hg + K * B;
+            float accg = 0.0f, acch = 0.0f;
+            for (int64_t b = 0; b < B; ++b) {
+                accg = accg + hg[b];  // seq_cumsum association
+                acch = acch + hh[b];
+                GL[f * B + b] = accg;
+                HL[f * B + b] = acch;
+            }
+            gm[f] = Gtot - accg;
+            hm[f] = Htot - acch;
+        }
+        const float parent_gain = calc_gain_c(Gtot, Htot, p);
+        float best = -INFINITY;
+        int64_t best_idx = 0;
+        for (int64_t dd = 0; dd < 2; ++dd) {
+            for (int64_t f = 0; f < F; ++f) {
+                if (!fmask[f]) continue;
+                for (int64_t b = 0; b < B; ++b) {
+                    const float GLd =
+                        dd == 0 ? GL[f * B + b] : GL[f * B + b] + gm[f];
+                    const float HLd =
+                        dd == 0 ? HL[f * B + b] : HL[f * B + b] + hm[f];
+                    const float GRd = Gtot - GLd;
+                    const float HRd = Htot - HLd;
+                    if (!(HLd >= p.mcw && HRd >= p.mcw)) continue;
+                    const float gain =
+                        calc_gain_c(GLd, HLd, p) + calc_gain_c(GRd, HRd, p);
+                    const float chg = gain - parent_gain;
+                    if (std::isnan(best)) {
+                        // first NaN wins and sticks (jnp.argmax semantics)
+                    } else if (std::isnan(chg) || chg > best) {
+                        best = chg;
+                        best_idx = dd * F * B + f * B + b;
+                    }
+                }
+            }
+        }
+        const int64_t dd = best_idx / (F * B);
+        const int64_t f = (best_idx % (F * B)) / B;
+        const int64_t b = best_idx % B;
+        const float GLb = dd == 0 ? GL[f * B + b] : GL[f * B + b] + gm[f];
+        const float HLb = dd == 0 ? HL[f * B + b] : HL[f * B + b] + hm[f];
+        const int64_t slot = off + k;
+        const bool can = (best > kRtEps) && (Htot > 0.0f);
+        is_split[slot] = can;
+        feature[slot] = (int32_t)f;
+        split_bin[slot] = (int32_t)b;
+        split_cond[slot] = cuts[f * B + b];
+        default_left[slot] = (dd == 1);
+        node_w[slot] = calc_weight_c(Gtot, Htot, p);
+        loss_chg[slot] = can ? best : 0.0f;
+        if (can) {
+            const int64_t l = 2 * slot + 1, r = 2 * slot + 2;
+            if (r < max_nodes) {
+                const float GRb = Gtot - GLb, HRb = Htot - HLb;
+                node_g[l] = GLb;
+                node_h[l] = HLb;
+                node_w[l] = calc_weight_c(GLb, HLb, p);
+                node_g[r] = GRb;
+                node_h[r] = HRb;
+                node_w[r] = calc_weight_c(GRb, HRb, p);
+            }
+        }
+    }
+}
+
+// Snapshot a level's decisions from the heap output arrays into the
+// compact typed form partition_rows consumes (Kp <= 2^(D-1) entries).
+void snapshot_decisions(const bool* is_split, const int32_t* feature,
+                        const int32_t* split_bin, const bool* default_left,
+                        int64_t poff, int64_t Kp, uint8_t* isplit,
+                        int32_t* feat, int32_t* bin, uint8_t* dleft) {
+    for (int64_t j = 0; j < Kp; ++j) {
+        isplit[j] = is_split[poff + j] ? 1 : 0;
+        feat[j] = feature[poff + j];
+        bin[j] = split_bin[poff + j];
+        dleft[j] = default_left[poff + j] ? 1 : 0;
+    }
+}
+
+// ---- whole-tree driver -------------------------------------------------
+
+template <typename BinT>
+void tree_grow_loop(const BinT* bins, const float* gh, const float* cuts,
+                    const int32_t* fmask, float G0, float H0, int64_t n,
+                    int64_t F, int64_t B, int64_t D, bool sub,
+                    const SplitP& p, int32_t* pos, bool* is_split,
+                    int32_t* feature, int32_t* split_bin, float* split_cond,
+                    bool* default_left, float* node_g, float* node_h,
+                    float* node_w, float* loss_chg) {
+    const int64_t max_nodes = (1LL << (D + 1)) - 1;
+    node_g[0] = G0;
+    node_h[0] = H0;
+    node_w[0] = calc_weight_c(G0, H0, p);
+    const int64_t Km = 1LL << (D - 1);  // widest evaluated level
+    std::vector<float> hist_a((size_t)(F * 2 * Km * B));
+    std::vector<float> hist_b((size_t)(F * 2 * Km * B));
+    float* cur = hist_a.data();
+    float* prev = hist_b.data();
+    std::vector<int64_t> counts((size_t)(2 * Km));
+    std::vector<uint8_t> bmask((size_t)(2 * Km));
+    std::vector<uint8_t> disp((size_t)Km), ddef((size_t)Km);
+    std::vector<int32_t> dfeat((size_t)Km), dbin((size_t)Km);
+    for (int64_t d = 0; d < D; ++d) {
+        const int64_t K = 1LL << d, off = K - 1;
+        const int64_t Kp = K >> 1, poff = Kp - 1;
+        if (d > 0) {
+            snapshot_decisions(is_split, feature, split_bin, default_left,
+                               poff, Kp, disp.data(), dfeat.data(),
+                               dbin.data(), ddef.data());
+            partition_rows(bins, pos, disp.data(), dfeat.data(), dbin.data(),
+                           ddef.data(), n, F, B, Kp, poff);
+        }
+        std::memset(cur, 0, (size_t)(F * 2 * K * B) * sizeof(float));
+        if (sub && d >= 1) {
+            count_rows(pos, n, off, K, counts.data());
+            plan_siblings(counts.data(), Kp, bmask.data());
+            accumulate_level(bins, pos, gh, n, F, B, K, off, bmask.data(),
+                             cur);
+            derive_siblings(prev, cur, F, B, K, Kp, counts.data());
+        } else {
+            accumulate_level(bins, pos, gh, n, F, B, K, off,
+                             (const uint8_t*)nullptr, cur);
+        }
+        eval_level(cur, cuts, fmask, F, B, K, off, p, is_split, feature,
+                   split_bin, split_cond, default_left, node_g, node_h,
+                   node_w, loss_chg, max_nodes);
+        std::swap(cur, prev);
+    }
+    // Final routing into the leaf level (the driver's partition_apply).
+    const int64_t Kp = 1LL << (D - 1), poff = Kp - 1;
+    snapshot_decisions(is_split, feature, split_bin, default_left, poff, Kp,
+                       disp.data(), dfeat.data(), dbin.data(), ddef.data());
+    partition_rows(bins, pos, disp.data(), dfeat.data(), dbin.data(),
+                   ddef.data(), n, F, B, Kp, poff);
+}
+
+ffi::Error TreeGrowImpl(
+    ffi::AnyBuffer bins, ffi::Buffer<ffi::F32> gh,
+    ffi::Buffer<ffi::F32> cut_values, ffi::Buffer<ffi::S32> tree_mask,
+    ffi::Buffer<ffi::F32> G0, ffi::Buffer<ffi::F32> H0, int64_t max_depth,
+    int64_t B, int64_t sibling_sub, float reg_lambda, float reg_alpha,
+    float max_delta_step, float min_child_weight,
+    ffi::Result<ffi::Buffer<ffi::S32>> pos_out,
+    ffi::Result<ffi::Buffer<ffi::PRED>> is_split,
+    ffi::Result<ffi::Buffer<ffi::S32>> feature,
+    ffi::Result<ffi::Buffer<ffi::S32>> split_bin,
+    ffi::Result<ffi::Buffer<ffi::F32>> split_cond,
+    ffi::Result<ffi::Buffer<ffi::PRED>> default_left,
+    ffi::Result<ffi::Buffer<ffi::F32>> node_g,
+    ffi::Result<ffi::Buffer<ffi::F32>> node_h,
+    ffi::Result<ffi::Buffer<ffi::F32>> node_w,
+    ffi::Result<ffi::Buffer<ffi::F32>> loss_chg) {
+    const auto dims = bins.dimensions();
+    if (dims.size() != 2) {
+        return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                          "bins must be [n, F]");
+    }
+    if (max_depth < 1) {
+        return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                          "max_depth must be >= 1");
+    }
+    const int64_t n = dims[0], F = dims[1];
+    const int64_t max_nodes = (1LL << (max_depth + 1)) - 1;
+    if ((int64_t)is_split->element_count() != max_nodes) {
+        return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                          "heap outputs must be [2^(max_depth+1) - 1]");
+    }
+    int32_t* pos = pos_out->typed_data();
+    std::memset(pos, 0, (size_t)n * sizeof(int32_t));
+    bool* isl = is_split->typed_data();
+    bool* dfl = default_left->typed_data();
+    std::memset(isl, 0, (size_t)max_nodes * sizeof(bool));
+    std::memset(dfl, 0, (size_t)max_nodes * sizeof(bool));
+    std::memset(feature->typed_data(), 0,
+                (size_t)max_nodes * sizeof(int32_t));
+    std::memset(split_bin->typed_data(), 0,
+                (size_t)max_nodes * sizeof(int32_t));
+    std::memset(split_cond->typed_data(), 0,
+                (size_t)max_nodes * sizeof(float));
+    std::memset(node_g->typed_data(), 0, (size_t)max_nodes * sizeof(float));
+    std::memset(node_h->typed_data(), 0, (size_t)max_nodes * sizeof(float));
+    std::memset(node_w->typed_data(), 0, (size_t)max_nodes * sizeof(float));
+    std::memset(loss_chg->typed_data(), 0,
+                (size_t)max_nodes * sizeof(float));
+    const SplitP p{reg_lambda, reg_alpha, max_delta_step, min_child_weight};
+    const float g0 = G0.typed_data()[0], h0 = H0.typed_data()[0];
+    if (bins.element_type() == ffi::U8) {
+        tree_grow_loop(reinterpret_cast<const uint8_t*>(bins.untyped_data()),
+                       gh.typed_data(), cut_values.typed_data(),
+                       tree_mask.typed_data(), g0, h0, n, F, B, max_depth,
+                       sibling_sub != 0, p, pos, isl, feature->typed_data(),
+                       split_bin->typed_data(), split_cond->typed_data(),
+                       dfl, node_g->typed_data(), node_h->typed_data(),
+                       node_w->typed_data(), loss_chg->typed_data());
+    } else if (bins.element_type() == ffi::U16) {
+        tree_grow_loop(reinterpret_cast<const uint16_t*>(bins.untyped_data()),
+                       gh.typed_data(), cut_values.typed_data(),
+                       tree_mask.typed_data(), g0, h0, n, F, B, max_depth,
+                       sibling_sub != 0, p, pos, isl, feature->typed_data(),
+                       split_bin->typed_data(), split_cond->typed_data(),
+                       dfl, node_g->typed_data(), node_h->typed_data(),
+                       node_w->typed_data(), loss_chg->typed_data());
+    } else {
+        return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                          "bins must be uint8 or uint16");
+    }
+    return ffi::Error::Success();
+}
+
+// ---- per-level sibling-subtraction kernel (kernelprof mirror) ----------
+
+template <typename BinT>
+void level_sub_impl(const BinT* bins, int32_t* pos, const float* gh,
+                    const float* ptab, const float* prev_hist, int64_t n,
+                    int64_t F, int64_t B, int64_t K, int64_t Kp,
+                    int64_t poff, int64_t off, float* hist) {
+    std::vector<uint8_t> isplit((size_t)Kp), dleft((size_t)Kp);
+    std::vector<int32_t> feat((size_t)Kp), bin((size_t)Kp);
+    for (int64_t j = 0; j < Kp; ++j) {
+        const float* dec = ptab + j * 4;
+        isplit[j] = dec[0] > 0.5f ? 1 : 0;
+        feat[j] = (int32_t)dec[1];
+        bin[j] = (int32_t)dec[2];
+        dleft[j] = dec[3] > 0.5f ? 1 : 0;
+    }
+    partition_rows(bins, pos, isplit.data(), feat.data(), bin.data(),
+                   dleft.data(), n, F, B, Kp, poff);
+    std::vector<int64_t> counts((size_t)K);
+    std::vector<uint8_t> bmask((size_t)K);
+    count_rows(pos, n, off, K, counts.data());
+    plan_siblings(counts.data(), Kp, bmask.data());
+    accumulate_level(bins, pos, gh, n, F, B, K, off, bmask.data(), hist);
+    derive_siblings(prev_hist, hist, F, B, K, Kp, counts.data());
+}
+
+ffi::Error HbLevelSubImpl(ffi::AnyBuffer bins, ffi::Buffer<ffi::S32> pos,
+                          ffi::Buffer<ffi::F32> gh,
+                          ffi::Buffer<ffi::F32> ptab,
+                          ffi::Buffer<ffi::F32> prev_hist,
+                          ffi::Buffer<ffi::S32> prev_offset,
+                          ffi::Buffer<ffi::S32> offset, int64_t K,
+                          int64_t Kp, int64_t B,
+                          ffi::Result<ffi::Buffer<ffi::S32>> pos_out,
+                          ffi::Result<ffi::Buffer<ffi::F32>> hist) {
+    const auto dims = bins.dimensions();
+    if (dims.size() != 2) {
+        return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                          "bins must be [n, F]");
+    }
+    if (Kp < 1 || K != 2 * Kp) {
+        return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                          "sibling level needs K == 2 * Kp, Kp >= 1");
+    }
+    const int64_t n = dims[0], F = dims[1];
+    const int64_t poff = prev_offset.typed_data()[0];
+    const int64_t off = offset.typed_data()[0];
+    int32_t* po_out = pos_out->typed_data();
+    std::memcpy(po_out, pos.typed_data(), (size_t)n * sizeof(int32_t));
+    float* h = hist->typed_data();
+    std::memset(h, 0, (size_t)(F * 2 * K * B) * sizeof(float));
+    if (bins.element_type() == ffi::U8) {
+        level_sub_impl(reinterpret_cast<const uint8_t*>(bins.untyped_data()),
+                       po_out, gh.typed_data(), ptab.typed_data(),
+                       prev_hist.typed_data(), n, F, B, K, Kp, poff, off, h);
+    } else if (bins.element_type() == ffi::U16) {
+        level_sub_impl(reinterpret_cast<const uint16_t*>(bins.untyped_data()),
+                       po_out, gh.typed_data(), ptab.typed_data(),
+                       prev_hist.typed_data(), n, F, B, K, Kp, poff, off, h);
+    } else {
+        return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                          "bins must be uint8 or uint16");
+    }
+    return ffi::Error::Success();
+}
+
+}  // namespace
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    XgbtpuTreeGrow, TreeGrowImpl,
+    ffi::Ffi::Bind()
+        .Arg<ffi::AnyBuffer>()           // bins [n, F] u8/u16
+        .Arg<ffi::Buffer<ffi::F32>>()    // gh [n, 2]
+        .Arg<ffi::Buffer<ffi::F32>>()    // cut_values [F, B]
+        .Arg<ffi::Buffer<ffi::S32>>()    // tree_mask [F] (0/1)
+        .Arg<ffi::Buffer<ffi::F32>>()    // G0 (0-d)
+        .Arg<ffi::Buffer<ffi::F32>>()    // H0 (0-d)
+        .Attr<int64_t>("max_depth")
+        .Attr<int64_t>("B")
+        .Attr<int64_t>("sibling_sub")
+        .Attr<float>("reg_lambda")
+        .Attr<float>("reg_alpha")
+        .Attr<float>("max_delta_step")
+        .Attr<float>("min_child_weight")
+        .Ret<ffi::Buffer<ffi::S32>>()    // pos_out [n, 1] (leaf level)
+        .Ret<ffi::Buffer<ffi::PRED>>()   // is_split [max_nodes]
+        .Ret<ffi::Buffer<ffi::S32>>()    // feature [max_nodes]
+        .Ret<ffi::Buffer<ffi::S32>>()    // split_bin [max_nodes]
+        .Ret<ffi::Buffer<ffi::F32>>()    // split_cond [max_nodes]
+        .Ret<ffi::Buffer<ffi::PRED>>()   // default_left [max_nodes]
+        .Ret<ffi::Buffer<ffi::F32>>()    // node_g [max_nodes]
+        .Ret<ffi::Buffer<ffi::F32>>()    // node_h [max_nodes]
+        .Ret<ffi::Buffer<ffi::F32>>()    // node_w [max_nodes]
+        .Ret<ffi::Buffer<ffi::F32>>());  // loss_chg [max_nodes]
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    XgbtpuHbLevelSub, HbLevelSubImpl,
+    ffi::Ffi::Bind()
+        .Arg<ffi::AnyBuffer>()           // bins [n, F] u8/u16
+        .Arg<ffi::Buffer<ffi::S32>>()    // pos [n, 1] (previous level)
+        .Arg<ffi::Buffer<ffi::F32>>()    // gh [n, 2]
+        .Arg<ffi::Buffer<ffi::F32>>()    // ptab [Kp, 4]
+        .Arg<ffi::Buffer<ffi::F32>>()    // prev_hist [F, 2Kp, B]
+        .Arg<ffi::Buffer<ffi::S32>>()    // prev_offset (0-d)
+        .Arg<ffi::Buffer<ffi::S32>>()    // offset (0-d)
+        .Attr<int64_t>("K")
+        .Attr<int64_t>("Kp")
+        .Attr<int64_t>("B")
+        .Ret<ffi::Buffer<ffi::S32>>()    // pos_out [n, 1]
+        .Ret<ffi::Buffer<ffi::F32>>());  // hist [F, 2K, B]

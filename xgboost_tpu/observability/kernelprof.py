@@ -37,6 +37,19 @@ repo already pins (scanned ≡ unrolled, PR 13; paged ≡ streaming, PR 15)
 and is pinned end-to-end by ``tests/test_kernelprof.py`` (model bytes
 equal with profiling on vs off).
 
+Single-dispatch rounds (ISSUE 17): when the production round runs the
+whole-tree native kernel (``tree_grow`` resolves to ``native``), there
+is exactly ONE dispatch to bracket — useless for attribution. The
+mirror therefore replays the round per-level, and when sibling
+subtraction is on it substitutes ``fused_level_sub_native`` at depth
+>= 1 — the FFI entry that shares tree_build.cpp's partition + build +
+subtract core loops — retaining the previous level's histogram between
+calls, so the replayed histograms (and hence the whole round) match the
+fused kernel's output bit-for-bit while every level still lands in its
+own ``level_hist`` bucket. The record carries ``route`` and
+``sibling_sub`` so a reader knows the numbers describe a per-level
+replay of a one-dispatch round.
+
 The record feeds the flight record as ``grow_detail`` (rendered by
 ``python -m xgboost_tpu grow-report``) and each bracket is emitted as a
 ``cat="grow"`` Chrome span, so the substages nest under the existing
@@ -59,7 +72,8 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 __all__ = [
     "should_sample", "arm", "active", "disarm",
-    "grow_tree_fused_profiled", "format_grow_detail", "main",
+    "grow_tree_fused_profiled", "format_grow_detail", "format_grow_diff",
+    "main",
 ]
 
 _ENV = "XGBTPU_KERNEL_PROF"
@@ -145,7 +159,7 @@ class _Profile:
     """Accumulator for ONE sampled round (all trees of the round)."""
 
     __slots__ = ("round_idx", "buckets", "host_syncs", "trees", "depth",
-                 "_last_done_ns")
+                 "route", "sibling_sub", "_last_done_ns")
 
     def __init__(self, round_idx: int) -> None:
         self.round_idx = int(round_idx)
@@ -154,6 +168,10 @@ class _Profile:
         self.host_syncs = 0
         self.trees = 0
         self.depth = -1
+        # production route the mirror replayed ("tree_grow" = the round
+        # would run as ONE native dispatch; "level" = per-level program)
+        self.route = "level"
+        self.sibling_sub = False
         self._last_done_ns = 0
 
     def record(self, op: str, depth: int, impl: str,
@@ -183,6 +201,8 @@ class _Profile:
         return {
             "round": self.round_idx,
             "driver": DRIVER,
+            "route": self.route,
+            "sibling_sub": self.sibling_sub,
             "trees": self.trees,
             "host_syncs": self.host_syncs,
             "sum_s": round(sum(b["wall_s"] for b in ops), 6),
@@ -312,6 +332,7 @@ def grow_tree_fused_profiled(bins, grad, hess, cut_values, key, eta, gamma,
         return _gf.grow_tree_fused(bins, grad, hess, cut_values, key,
                                    eta, gamma, cfg, feature_weights, onehot)
 
+    import jax
     import jax.numpy as jnp
 
     from .. import dispatch
@@ -319,12 +340,31 @@ def grow_tree_fused_profiled(bins, grad, hess, cut_values, key, eta, gamma,
     from . import trace as _trace
 
     pallas = _gf._pallas_flag(cfg)
+    max_depth = cfg.max_depth
+    # Which route would the PRODUCTION program take? Resolved with the
+    # original bins dtype (the pallas path widens to i32 below). When
+    # the answer is the whole-tree kernel, the mirror replays per-level
+    # with the sibling-subtraction FFI entry at d >= 1 (bit-identical by
+    # shared C++ core loops — see module docstring).
+    route = ("tree_grow"
+             if _gf._use_tree_grow(cfg, bool(pallas), max_depth,
+                                   str(bins.dtype))
+             else "level")
+    sub_on = False
+    if route == "tree_grow":
+        sub_on = dispatch.resolve("sibling_sub", dispatch.Ctx(
+            platform=jax.default_backend())).impl == "on"
+    prof.route = route
+    prof.sibling_sub = sub_on
     if pallas:
         bins = bins.astype(jnp.int32)
     n, F = bins.shape
     B = cut_values.shape[1]
-    max_depth = cfg.max_depth
     prof.trees += 1
+    # start the gap clock at mirror entry so the setup before the first
+    # bracket (route resolution, span entry) lands in prep's gap column
+    # instead of vanishing from the attribution
+    prof._last_done_ns = time.perf_counter_ns()
     prev = dispatch.set_invoke_hook(_hook(prof))
     try:
         with _trace.span("grow_tree", fused=True, instrumented=True,
@@ -334,13 +374,23 @@ def grow_tree_fused_profiled(bins, grad, hess, cut_values, key, eta, gamma,
                 "prep", _prep_fn(), grad, hess, key, feature_weights,
                 cfg=cfg, F=int(F), B=int(B))
             pos = jnp.zeros((n, 1), jnp.int32)
+            prev_hist = None
             for d in range(max_depth):
                 prof.depth = d
                 K = 1 << d
-                pos, histC = dispatch.invoke(
-                    "level_hist", _hk.fused_level, bins, pos, gh, st.ptab,
-                    K=K, Kp=K >> 1, B=B, d=d, pallas=pallas, onehot=onehot,
-                    axis_name=None)
+                if route == "tree_grow" and sub_on and d >= 1:
+                    from ..tree import tree_kernel as _tk
+
+                    pos, histC = dispatch.invoke(
+                        "level_hist", _tk.fused_level_sub_native, bins,
+                        pos, gh, st.ptab, prev_hist, K=K, Kp=K >> 1, B=B,
+                        d=d)
+                else:
+                    pos, histC = dispatch.invoke(
+                        "level_hist", _hk.fused_level, bins, pos, gh,
+                        st.ptab, K=K, Kp=K >> 1, B=B, d=d, pallas=pallas,
+                        onehot=onehot, axis_name=None)
+                prev_hist = histC
                 st = dispatch.invoke(
                     "level_update", _gf._level_update_jit, st, histC,
                     cut_values, tree_mask, k_level, cfg=cfg, d=d)
@@ -378,9 +428,17 @@ def format_grow_detail(rec: Dict[str, Any],
     """Render one ``grow_detail`` record as the per-depth × per-op table.
     ``grow_s`` (the round's ``stages.grow``) adds the coverage line —
     the acceptance contract is substages summing to within 10% of it."""
+    route = rec.get("route")
+    route_note = ""
+    if route:
+        route_note = f", route={route}"
+        if route == "tree_grow":
+            # per-level replay of a one-dispatch production round
+            route_note += (" (sibling-sub replay)" if rec.get("sibling_sub")
+                           else " (per-level replay)")
     lines = [
         f"round {rec.get('round')}: grow detail "
-        f"({rec.get('driver')}, {rec.get('trees')} tree(s))",
+        f"({rec.get('driver')}, {rec.get('trees')} tree(s){route_note})",
         f"  {'depth':>5} {'op':<16} {'impl':<8} {'count':>5} "
         f"{'wall':>10} {'host':>10} {'inflight':>10} {'gap':>9}",
     ]
@@ -403,6 +461,63 @@ def format_grow_detail(rec: Dict[str, Any],
         total += (f"; stages.grow {ms(grow_s)} "
                   f"(substages = {100.0 * rec.get('sum_s', 0.0) / grow_s:.1f}%)")
     lines.append(total)
+    return "\n".join(lines)
+
+
+def _aggregate_ops(recs: List[Dict[str, Any]]) -> Tuple[
+        Dict[Tuple[int, str], Dict[str, Any]], List[int]]:
+    """Sum per-(depth, op) wall seconds across sampled round records —
+    the input to the ``--diff`` table. Returns ``(buckets, rounds)``."""
+    agg: Dict[Tuple[int, str], Dict[str, Any]] = {}
+    rounds: List[int] = []
+    for r in recs:
+        gd = r.get("grow_detail", {})
+        rounds.append(gd.get("round", r.get("round", -1)))
+        for b in gd.get("ops", ()):
+            key = (b.get("depth", -1), b.get("op", "?"))
+            cur = agg.setdefault(key, {"wall_s": 0.0, "count": 0,
+                                       "impl": b.get("impl", "?")})
+            cur["wall_s"] += b.get("wall_s", 0.0)
+            cur["count"] += b.get("count", 0)
+            cur["impl"] = b.get("impl", cur["impl"])
+    return agg, rounds
+
+
+def format_grow_diff(agg_a: Dict[Tuple[int, str], Dict[str, Any]],
+                     rounds_a: List[int], label_a: str,
+                     agg_b: Dict[Tuple[int, str], Dict[str, Any]],
+                     rounds_b: List[int], label_b: str) -> str:
+    """Render the A-vs-B per-depth × per-op table with a delta column
+    (B − A; negative = B faster). Rows missing on one side show '-' —
+    e.g. a depth the other run never grew, or an op only one route
+    dispatches."""
+    lines = [
+        f"grow detail diff: A = {label_a} (rounds {sorted(set(rounds_a))}) "
+        f"vs B = {label_b} (rounds {sorted(set(rounds_b))})",
+        f"  {'depth':>5} {'op':<16} {'impl':<16} {'A wall':>10} "
+        f"{'B wall':>10} {'delta':>10}",
+    ]
+
+    def ms(v: Optional[float]) -> str:
+        return "-" if v is None else f"{v * 1e3:.3f}ms"
+
+    tot_a = tot_b = 0.0
+    for depth, op in sorted(set(agg_a) | set(agg_b)):
+        a = agg_a.get((depth, op))
+        b = agg_b.get((depth, op))
+        wa = a["wall_s"] if a else None
+        wb = b["wall_s"] if b else None
+        tot_a += wa or 0.0
+        tot_b += wb or 0.0
+        ia = a["impl"] if a else "-"
+        ib = b["impl"] if b else "-"
+        impl = ia if ia == ib else f"{ia}->{ib}"
+        delta = "-" if (wa is None or wb is None) else ms(wb - wa)
+        lines.append(
+            f"  {('prep' if depth < 0 else depth)!s:>5} {op:<16} "
+            f"{impl:<16} {ms(wa):>10} {ms(wb):>10} {delta:>10}")
+    lines.append(f"  substages A {ms(tot_a)}, B {ms(tot_b)}, "
+                 f"delta {ms(tot_b - tot_a)}")
     return "\n".join(lines)
 
 
@@ -437,7 +552,8 @@ def _find_flight_files(arg: str) -> List[str]:
 
 def main(argv: List[str]) -> int:
     usage = ("usage: python -m xgboost_tpu grow-report "
-             "<flight.jsonl|run-dir> [--round N]")
+             "<flight.jsonl|run-dir> [--round N] | "
+             "grow-report --diff <A> <B> [--round N]")
     if not argv or argv[0] in ("-h", "--help"):
         print(usage, file=sys.stderr)
         return 0 if argv else 1
@@ -450,6 +566,35 @@ def main(argv: List[str]) -> int:
             print(usage, file=sys.stderr)
             return 1
         argv = argv[:i] + argv[i + 2:]
+    if "--diff" in argv:
+        rest = [a for a in argv if a != "--diff"]
+        if len(rest) != 2:
+            print(usage, file=sys.stderr)
+            return 1
+        sides = []
+        for arg in rest:
+            recs: List[Dict[str, Any]] = []
+            for path in _find_flight_files(arg):
+                try:
+                    recs.extend(
+                        r for r in _iter_flight_lines(path)
+                        if r.get("t") == "round" and "grow_detail" in r)
+                except OSError as e:
+                    print(f"{path}: {e}", file=sys.stderr)
+                    return 1
+            if want_round is not None:
+                recs = [r for r in recs if r.get("round") == want_round]
+            if not recs:
+                print(f"{arg}: no sampled grow_detail records found "
+                      f"(profiler arms via {_ENV}=every=N|rounds=a,b,c)",
+                      file=sys.stderr)
+                return 1
+            sides.append((arg, recs))
+        (la, ra), (lb, rb) = sides
+        agg_a, rounds_a = _aggregate_ops(ra)
+        agg_b, rounds_b = _aggregate_ops(rb)
+        print(format_grow_diff(agg_a, rounds_a, la, agg_b, rounds_b, lb))
+        return 0
     paths = _find_flight_files(argv[0])
     if not paths:
         print(f"{argv[0]}: no flight.jsonl found", file=sys.stderr)

@@ -55,7 +55,7 @@ from .param import RT_EPS, SplitParams, calc_gain, calc_gain_given_weight, calc_
 __all__ = [
     "GrowParams", "HeapTree", "SplitDecision", "grow_tree", "prune_heap",
     "leaf_value_map", "eval_splits", "child_bounds_and_weights",
-    "interaction_allowed",
+    "interaction_allowed", "seq_cumsum",
 ]
 
 _INF = float(np.inf)
@@ -290,6 +290,22 @@ def blocked_histogram(
     return hist
 
 
+def seq_cumsum(x: jax.Array) -> jax.Array:
+    """Cumulative sum over the last axis with STRICT left-to-right f32
+    association (((0+x0)+x1)+...). ``jnp.cumsum`` lowers to a
+    reduce_window whose float association is backend-dependent; the
+    native ``tree_grow`` kernel replicates split evaluation bit-for-bit,
+    which requires an association a sequential C loop can reproduce."""
+    xm = jnp.moveaxis(x, -1, 0)
+
+    def step(c, v):
+        c2 = c + v
+        return c2, c2
+
+    _, ys = jax.lax.scan(step, jnp.zeros(xm.shape[1:], x.dtype), xm)
+    return jnp.moveaxis(ys, 0, -1)
+
+
 class SplitDecision(NamedTuple):
     """Best split per node row (all [K])."""
 
@@ -332,8 +348,8 @@ def eval_splits(
     K, F = hist.shape[0], hist.shape[1]
     g_b, h_b = hist[:, :, :B, 0], hist[:, :, :B, 1]
     g_miss, h_miss = hist[:, :, B, 0], hist[:, :, B, 1]
-    GL = jnp.cumsum(g_b, axis=-1)
-    HL = jnp.cumsum(h_b, axis=-1)
+    GL = seq_cumsum(g_b)
+    HL = seq_cumsum(h_b)
     # dir 0: missing goes right (default_left=False); dir 1: missing left
     GLd = jnp.stack([GL, GL + g_miss[..., None]], axis=1)  # [K, 2, F, B]
     HLd = jnp.stack([HL, HL + h_miss[..., None]], axis=1)

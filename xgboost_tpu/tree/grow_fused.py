@@ -44,6 +44,7 @@ from .grow import (
     eval_splits,
     exact_k_subset,
     interaction_allowed,
+    seq_cumsum,
 )
 from .hist_kernel import (
     TR,
@@ -179,8 +180,11 @@ def _level_update(
 
     hg = jnp.transpose(histC[:, :K, :], (1, 0, 2))  # [K, F, B]
     hh = jnp.transpose(histC[:, K:, :], (1, 0, 2))
-    g_miss = Gtot[:, None] - hg.sum(-1)
-    h_miss = Htot[:, None] - hh.sum(-1)
+    # Present-value totals via the same strict left-to-right association
+    # eval_splits' seq_cumsum uses, so the native tree_grow kernel can
+    # reproduce g_miss/h_miss exactly (a single C loop over bins).
+    g_miss = Gtot[:, None] - seq_cumsum(hg)[..., -1]
+    h_miss = Htot[:, None] - seq_cumsum(hh)[..., -1]
     hist = jnp.stack(
         [
             jnp.concatenate([hg, g_miss[..., None]], axis=-1),
@@ -407,7 +411,30 @@ def _grow_tree_fused_impl(
     st = _init_state(cfg, F, G0, H0, B)
 
     pos = jnp.zeros((n, 1), jnp.int32)
-    if _use_depth_scan(cfg, pallas, max_depth):
+    tree_grow_native_route = _use_tree_grow(cfg, pallas, max_depth,
+                                            str(bins.dtype))
+    if tree_grow_native_route:
+        # whole-round kernel (ISSUE 17 tentpole): the ENTIRE depth loop —
+        # per-level partition, histogram (with sibling subtraction), split
+        # eval and heap update, plus the final leaf routing — runs as ONE
+        # native custom call per round instead of ~2 dispatches per level.
+        # The kernel's outputs satisfy _level_update's state contract
+        # bit-for-bit (subtraction off), so _finalize consumes them
+        # unchanged. Sibling subtraction resolves through its own table
+        # row (XGBTPU_SIBLING_SUB=0 -> sibling_sub=off pin).
+        from ..dispatch import Ctx, resolve
+        from .tree_kernel import tree_grow_native
+
+        sub_on = resolve("sibling_sub", Ctx(
+            platform=jax.default_backend())).impl == "on"
+        (pos, isl, feat, sbin, scond, dleft, ng, nh, nw, lchg) = \
+            tree_grow_native(bins, gh, cut_values, tree_mask, G0, H0,
+                             max_depth=max_depth, B=B, sibling_sub=sub_on,
+                             split=p)
+        st = st._replace(is_split=isl, feature=feat, split_bin=sbin,
+                         split_cond=scond, default_left=dleft, node_g=ng,
+                         node_h=nh, node_w=nw, loss_chg=lchg)
+    elif _use_depth_scan(cfg, pallas, max_depth):
         # fused depth scan (ISSUE 13 tentpole): the per-level bodies
         # collapse into ONE lax.scan over the depth counter at the
         # deepest level's fixed node width — a depth-6 tree stages one
@@ -470,7 +497,9 @@ def _grow_tree_fused_impl(
                                cfg, d)
 
     # ---- route rows through the last level's splits to their leaves ----
-    if max_depth > 0:
+    # (folded into the whole-tree kernel when that route ran: its pos
+    # output is already at the leaf level)
+    if max_depth > 0 and not tree_grow_native_route:
         pos = partition_apply(
             bins, pos, st.ptab, Kp=1 << (max_depth - 1), B=B, d=max_depth,
             axis_name=cfg.axis_name,
@@ -487,6 +516,31 @@ def _grow_tree_fused_impl(
         loss_chg=st.loss_chg, leaf_value=leaf_value, delta=delta,
         cat_set=st.cat_set,
     )
+
+
+def _use_tree_grow(cfg: GrowParams, pallas: bool, max_depth: int,
+                   bins_dtype: str) -> bool:
+    """Whether the round runs as ONE native whole-tree custom call —
+    resolved through the dispatch registry (``tree_grow``: native >
+    level). The native impl's envelope (``dispatch/ops.py``) is the
+    per-level native kernel's plus the eval features the C++ port
+    replicates bitwise: no per-level/per-node colsample draws, no
+    monotone/interaction constraints, no categorical tables and
+    ``max_delta_step == 0``. Everything else keeps the per-level path
+    (``level``), including all of pallas/mesh/paged."""
+    from ..dispatch import Ctx, resolve
+    from . import hist_kernel as _hk
+
+    return resolve("tree_grow", Ctx(
+        platform=jax.default_backend(), pallas=bool(pallas),
+        interpret=bool(_hk._INTERPRET),
+        sharded=cfg.axis_name is not None,
+        has_cats=bool(cfg.has_categorical), bins_dtype=bins_dtype,
+        depth=int(max_depth), monotone=bool(cfg.has_monotone),
+        interaction=bool(cfg.has_interaction),
+        colsample_level=float(cfg.colsample_bylevel),
+        colsample_node=float(cfg.colsample_bynode),
+        max_delta_step=float(cfg.split.max_delta_step))).impl == "native"
 
 
 def _use_depth_scan(cfg: GrowParams, pallas: bool, max_depth: int) -> bool:

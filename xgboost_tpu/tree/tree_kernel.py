@@ -1,0 +1,126 @@
+"""Whole-tree native grow kernel wrappers (the ``tree_grow`` dispatch op).
+
+``native/tree_build.cpp`` runs the ENTIRE depth loop of one boosting round
+in a single XLA FFI custom call — per-level partition, histogram build
+(with sibling subtraction), split eval and heap update — returning the
+finalized heap arrays ``_finalize_jit`` consumes plus the leaf-level row
+positions. The in-core CPU round drops from ~2 dispatches per level
+(``fused_level`` + ``_level_update_jit``) to ONE host round-trip per round.
+
+Two FFI entries are registered together (they share the C++ core loops, so
+their histograms are bit-identical by construction):
+
+* ``xgbtpu_tree_grow`` — the whole-tree kernel (``tree_grow_native``).
+* ``xgbtpu_hb_level_sub`` — ONE level of the same partition + sibling-
+  subtraction machinery (``fused_level_sub_native``), used by the
+  kernelprof mirror so sampled rounds can replay the round per-level for
+  attribution while staying bit-identical to the fused kernel's output.
+
+Route selection lives in the dispatch registry (``dispatch/ops.py``, op
+``tree_grow``); the ``XGBTPU_SIBLING_SUB=0`` kill switch maps to a
+``sibling_sub=off`` pin there and makes the kernel bit-identical to the
+per-level native path (see tree_build.cpp's contract comment).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "tree_grow_native", "fused_level_sub_native", "tree_ffi_ready",
+]
+
+_ffi_lock = threading.Lock()
+_ffi_state = {"registered": None}  # None = not tried, True/False = result
+
+
+def tree_ffi_ready() -> bool:
+    """Build/load ``libtreebuild.so`` and register its FFI handlers with
+    XLA (once per process). The ``tree_grow`` registry impl's availability
+    probe. False when the toolchain or jaxlib FFI headers are missing."""
+    with _ffi_lock:
+        if _ffi_state["registered"] is not None:
+            return _ffi_state["registered"]
+        _ffi_state["registered"] = False
+        try:
+            from jax.extend import ffi as jffi
+
+            from ..native import get_tree_lib
+
+            lib = get_tree_lib()
+            if lib is None:
+                return False
+            jffi.register_ffi_target(
+                "xgbtpu_tree_grow", jffi.pycapsule(lib.XgbtpuTreeGrow),
+                platform="cpu")
+            jffi.register_ffi_target(
+                "xgbtpu_hb_level_sub", jffi.pycapsule(lib.XgbtpuHbLevelSub),
+                platform="cpu")
+            _ffi_state["registered"] = True
+        except Exception:
+            return False
+        return True
+
+
+def tree_grow_native(bins, gh, cut_values, tree_mask, G0, H0, *,
+                     max_depth: int, B: int, sibling_sub: bool, split):
+    """One boosting round's depth loop as a single custom call.
+
+    Returns ``(pos, is_split, feature, split_bin, split_cond, default_left,
+    node_g, node_h, node_w, loss_chg)`` — ``pos`` [n, 1] i32 already routed
+    into the LEAF level (the driver's final ``partition_apply`` is folded
+    in), the rest heap arrays of ``max_nodes = 2^(max_depth+1) - 1``
+    matching ``_level_update``'s state contract bit-for-bit (sub off).
+    Scalar split params travel as f32 attributes — the same f64 -> f32
+    rounding XLA applies to Python float constants at trace time."""
+    from jax.extend import ffi as jffi
+
+    n, F = bins.shape
+    max_nodes = (1 << (max_depth + 1)) - 1
+    mn = (max_nodes,)
+    return jffi.ffi_call(
+        "xgbtpu_tree_grow",
+        (jax.ShapeDtypeStruct((n, 1), jnp.int32),
+         jax.ShapeDtypeStruct(mn, jnp.bool_),     # is_split
+         jax.ShapeDtypeStruct(mn, jnp.int32),     # feature
+         jax.ShapeDtypeStruct(mn, jnp.int32),     # split_bin
+         jax.ShapeDtypeStruct(mn, jnp.float32),   # split_cond
+         jax.ShapeDtypeStruct(mn, jnp.bool_),     # default_left
+         jax.ShapeDtypeStruct(mn, jnp.float32),   # node_g
+         jax.ShapeDtypeStruct(mn, jnp.float32),   # node_h
+         jax.ShapeDtypeStruct(mn, jnp.float32),   # node_w
+         jax.ShapeDtypeStruct(mn, jnp.float32)),  # loss_chg
+        bins, gh, cut_values, tree_mask.astype(jnp.int32),
+        G0.astype(jnp.float32), H0.astype(jnp.float32),
+        max_depth=int(max_depth), B=int(B),
+        sibling_sub=int(bool(sibling_sub)),
+        reg_lambda=np.float32(split.reg_lambda),
+        reg_alpha=np.float32(split.reg_alpha),
+        max_delta_step=np.float32(split.max_delta_step),
+        min_child_weight=np.float32(split.min_child_weight))
+
+
+def fused_level_sub_native(bins, pos, gh, ptab, prev_hist, *, K: int,
+                           Kp: int, B: int, d: int):
+    """Same contract as ``fused_level_native`` — (new pos [n,1] i32, hist
+    [F, 2K, B] f32) — but building only the smaller child of each sibling
+    pair and deriving the other as parent − child from ``prev_hist`` (the
+    previous level's [F, 2Kp, B]). Only valid at ``d >= 1``. This is the
+    kernelprof mirror's level step when the round ran the whole-tree
+    kernel with subtraction on: it shares tree_build.cpp's core loops, so
+    the mirrored histogram matches the in-kernel one bit-for-bit."""
+    from jax.extend import ffi as jffi
+
+    n, F = bins.shape
+    prev_offset = jnp.int32((1 << (d - 1)) - 1)
+    offset = jnp.int32((1 << d) - 1)
+    return jffi.ffi_call(
+        "xgbtpu_hb_level_sub",
+        (jax.ShapeDtypeStruct((n, 1), jnp.int32),
+         jax.ShapeDtypeStruct((F, 2 * K, B), jnp.float32)),
+        bins, pos, gh, ptab, prev_hist, prev_offset, offset,
+        K=K, Kp=Kp, B=B)
