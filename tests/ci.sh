@@ -4,9 +4,11 @@
 # elastic and serving lanes between 1 and 2):
 #   0. static-analysis gate: `python -m xgboost_tpu lint` must exit 0 —
 #      any unsuppressed trace-safety / retrace / dtype / concurrency
-#      finding (docs/static_analysis.md) fails CI before a single test
-#      runs; the gate also self-checks that the seeded fixture still
-#      trips every rule (a rule that stops firing has silently died)
+#      finding, FFI contract drift (NB6xx), OpenMP determinism hazard
+#      (OMP7xx) or code-vs-docs drift (DR8xx) (docs/static_analysis.md)
+#      fails CI before a single test runs; the gate also self-checks
+#      that the seeded fixtures still trip every rule (a rule that
+#      stops firing has silently died)
 #   1. standard suite on the virtual 8-device CPU mesh, with span tracing
 #      live (XGBTPU_TRACE) so the emitter is exercised by every test
 #   2. trace validation: the tier-1 trace must parse as Chrome trace JSON
@@ -15,8 +17,10 @@
 #      ASan/UBSan: any NaN produced inside a jitted program raises)
 #   4. x64 parity spot-check (sketch/histogram math stable when jax
 #      promotes to float64 — catches accidental precision dependence)
-# The native sanitizer lane (XGBTPU_SAN=1 + ASan/UBSan round-trip) lives
-# in the slow suite: `pytest tests/test_sanitizer.py -m slow`.
+# The native sanitizer lanes (XGBTPU_SAN=1 + ASan/UBSan round-trip,
+# XGBTPU_SAN=thread + TSan over the OpenMP tree grow / prefetcher /
+# async checkpoint writer) live in the slow suite:
+# `pytest tests/test_sanitizer.py -m slow`.
 set -e
 cd "$(dirname "$0")/.."
 export JAX_PLATFORMS=cpu
@@ -24,12 +28,19 @@ unset PALLAS_AXON_POOL_IPS
 
 echo "=== tier 0: static-analysis gate ==="
 python -m xgboost_tpu lint
-# self-check: the seeded fixture must trip EVERY rule in the catalog —
-# asserting only a non-zero exit would let one surviving rule mask nine
-# dead ones
+# the cross-boundary families again as an explicit named invocation:
+# rc 1 on ANY FFI-contract / OpenMP-determinism / docs-drift finding
+# (they run clean with zero baseline entries, so a regression here is
+# always a new finding, never a suppression drift)
+python -m xgboost_tpu lint --rules \
+    NB601,NB602,NB603,NB604,OMP701,OMP702,OMP703,OMP704,DR801,DR802,DR803
+# self-check: the seeded fixture set must trip EVERY rule in the
+# catalog — asserting only a non-zero exit would let one surviving rule
+# mask nine dead ones (and a deleted fixture file must be caught, not
+# silently shrink coverage)
 python - <<'EOF'
 from xgboost_tpu.analysis.lint import ALL_RULES, lint_paths
-hit = {f.rule for f in lint_paths(["tests/fixtures/lint_violations.py"])}
+hit = {f.rule for f in lint_paths(["tests/fixtures"])}
 missing = sorted(set(ALL_RULES) - hit)
 assert not missing, f"lint rules no longer firing: {missing}"
 print(f"lint self-check OK: all {len(ALL_RULES)} rules fire")

@@ -1,0 +1,56 @@
+// Seeded NB6xx violations — one per rule, plus one fully-consistent
+// handler proving the checker stays silent on a correct contract.
+// NEVER compiled: this TU is parsed by analysis/ffi_contract.py via
+// tests/test_lint.py and the CI gate self-check. Its Python half lives
+// in native_contract_violations.py (registrations + call-site stubs).
+
+#include <cstdint>
+
+// --- consistent pair: no finding -----------------------------------------
+ffi::Error FixtureOkImpl(ffi::Buffer<ffi::F32> x, int64_t n,
+                         ffi::Result<ffi::Buffer<ffi::F32>> out);
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    XgbtpuFixtureOk, FixtureOkImpl,
+    ffi::Ffi::Bind()
+        .Arg<ffi::Buffer<ffi::F32>>()   // x
+        .Attr<int64_t>("n")
+        .Ret<ffi::Buffer<ffi::F32>>()); // out
+
+// --- NB601: the call-site stub passes THREE operands ---------------------
+ffi::Error FixtureArityImpl(ffi::Buffer<ffi::F32> x, ffi::Buffer<ffi::F32> y,
+                            ffi::Result<ffi::Buffer<ffi::F32>> out);
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    XgbtpuFixtureArity, FixtureArityImpl,
+    ffi::Ffi::Bind()
+        .Arg<ffi::Buffer<ffi::F32>>()   // x
+        .Arg<ffi::Buffer<ffi::F32>>()   // y
+        .Ret<ffi::Buffer<ffi::F32>>()); // out
+
+// --- NB602: the call-site stub casts its operand to int32 ----------------
+ffi::Error FixtureDtypeImpl(ffi::Buffer<ffi::F32> x,
+                            ffi::Result<ffi::Buffer<ffi::F32>> out);
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    XgbtpuFixtureDtype, FixtureDtypeImpl,
+    ffi::Ffi::Bind()
+        .Arg<ffi::Buffer<ffi::F32>>()   // x (call site sends S32)
+        .Ret<ffi::Buffer<ffi::F32>>()); // out
+
+// --- NB603: two results bound, the call-site stub declares one -----------
+ffi::Error FixtureRetsImpl(ffi::Buffer<ffi::F32> x,
+                           ffi::Result<ffi::Buffer<ffi::F32>> a,
+                           ffi::Result<ffi::Buffer<ffi::F32>> b);
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    XgbtpuFixtureRets, FixtureRetsImpl,
+    ffi::Ffi::Bind()
+        .Arg<ffi::Buffer<ffi::F32>>()   // x
+        .Ret<ffi::Buffer<ffi::F32>>()   // a
+        .Ret<ffi::Buffer<ffi::F32>>()); // b (dropped by the call site)
+
+// --- NB604: registered by the stub but never called ----------------------
+ffi::Error FixtureOrphanImpl(ffi::Buffer<ffi::F32> x,
+                             ffi::Result<ffi::Buffer<ffi::F32>> out);
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    XgbtpuFixtureOrphan, FixtureOrphanImpl,
+    ffi::Ffi::Bind()
+        .Arg<ffi::Buffer<ffi::F32>>()   // x
+        .Ret<ffi::Buffer<ffi::F32>>()); // out

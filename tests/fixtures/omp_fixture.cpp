@@ -1,0 +1,50 @@
+// Seeded OMP7xx violations — one per pragma rule. NEVER compiled: this
+// TU is parsed by analysis/omp_lint.py via tests/test_lint.py and the
+// CI gate self-check (OMP704's seed is the _compile() stub in
+// native_contract_violations.py — it is a build-flag rule, not a
+// pragma rule). The clean loop at the bottom pins the disjoint-slab
+// discipline the real kernels use as a non-finding.
+
+#include <cstdint>
+
+// OMP701: float reduction — partials combine in runtime-chosen order.
+float fixture_reduction(const float* v, int64_t n) {
+    float acc = 0.0f;
+#pragma omp parallel for reduction(+:acc)
+    for (int64_t i = 0; i < n; ++i) {
+        acc += v[i];
+    }
+    return acc;
+}
+
+// OMP702: atomic float update — atomic but unordered accumulation.
+void fixture_atomic(const float* v, int64_t n, float* total_out) {
+    float total = 0.0f;
+#pragma omp parallel for
+    for (int64_t i = 0; i < n; ++i) {
+#pragma omp atomic
+        total += v[i];
+    }
+    *total_out = total;
+}
+
+// OMP703: every thread writes the same cell of a shared float array
+// through a loop-invariant index.
+void fixture_shared_write(const float* v, int64_t n, float* sink) {
+    const int64_t cell = 0;
+#pragma omp parallel for
+    for (int64_t i = 0; i < n; ++i) {
+        sink[cell] += v[i];
+    }
+}
+
+// Clean: the disjoint-slab discipline (induction-indexed writes and a
+// body-local slab pointer) must stay silent.
+void fixture_clean(const float* v, int64_t n, float* out, float* hist) {
+#pragma omp parallel for
+    for (int64_t i = 0; i < n; ++i) {
+        out[i] = v[i] * 2.0f;
+        float* slab = hist + i * 4;
+        for (int64_t b = 0; b < 4; ++b) slab[b] += v[i];
+    }
+}

@@ -14,7 +14,14 @@ from xgboost_tpu.analysis.lint import ALL_RULES, Finding, lint_paths, run_lint
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 REPO = os.path.dirname(HERE)
-FIXTURE = os.path.join(HERE, "fixtures", "lint_violations.py")
+FIXTURE_DIR = os.path.join(HERE, "fixtures")
+FIXTURE = os.path.join(FIXTURE_DIR, "lint_violations.py")
+# the cross-boundary fixture set (ISSUE 18): the NB6xx .cpp handlers,
+# their Python registration/call-site stub, and the OMP7xx pragmas
+FIXTURE_FFI_CPP = os.path.join(FIXTURE_DIR, "ffi_contract_fixture.cpp")
+FIXTURE_OMP_CPP = os.path.join(FIXTURE_DIR, "omp_fixture.cpp")
+FIXTURE_NATIVE_PY = os.path.join(FIXTURE_DIR,
+                                 "native_contract_violations.py")
 
 
 # ---------------------------------------------------------------------------
@@ -37,8 +44,10 @@ def test_package_lints_clean_against_baseline():
     # on the round path are contractual host consumers, each justified;
     # 48 -> 50 with CC405: the five blessed use_pallas() probe sites that
     # FEED the dispatch ctx — every actual impl choice now resolves
-    # through dispatch/, and two pre-dispatch entries were pruned)
-    assert len(suppressed) < 50
+    # through dispatch/, and two pre-dispatch entries were pruned;
+    # re-tightened to 48 with the cross-boundary families: NB6xx/OMP7xx/
+    # DR8xx all run clean on the fixed package, zero new suppressions)
+    assert len(suppressed) < 48
 
 
 def test_baseline_entries_all_justified():
@@ -49,13 +58,44 @@ def test_baseline_entries_all_justified():
 
 
 def test_fixture_trips_every_rule():
-    """One seeded violation per rule: a rule that stops firing here has
-    silently died."""
-    findings = lint_paths([FIXTURE])
+    """One seeded violation per rule across the fixture set: a rule that
+    stops firing here has silently died."""
+    findings = lint_paths([FIXTURE_DIR])
     hit = {f.rule for f in findings}
     assert hit == set(ALL_RULES), (
         f"rules not firing: {sorted(set(ALL_RULES) - hit)}; "
         f"unknown rules: {sorted(hit - set(ALL_RULES))}")
+
+
+def test_cross_boundary_rules_fire_exactly_once_each():
+    """Every NB6xx/OMP7xx/DR8xx seed produces exactly ONE finding of its
+    rule, and the consistent fixture_ok handler/call pair produces none
+    — the checkers are precise, not merely noisy."""
+    findings = lint_paths([FIXTURE_DIR])
+    new_rules = [r for r in ALL_RULES
+                 if r.startswith(("NB", "OMP", "DR"))]
+    for rule in new_rules:
+        hits = [f for f in findings if f.rule == rule]
+        assert len(hits) == 1, (
+            f"{rule}: expected exactly 1 fixture finding, got "
+            f"{[f.render() for f in hits]}")
+    assert not any("fixture_ok" in (f.symbol or "") or
+                   "XgbtpuFixtureOk" in f.message
+                   for f in findings), \
+        "the consistent fixture_ok pair must stay silent"
+
+
+def test_gate_self_check_catches_removed_fixture(tmp_path):
+    """Deleting one fixture file kills its rules' seeds: the every-rule
+    assertion (the CI self-check) must detect the hole."""
+    import shutil
+
+    broken = tmp_path / "fixtures"
+    shutil.copytree(FIXTURE_DIR, broken)
+    (broken / "omp_fixture.cpp").unlink()
+    hit = {f.rule for f in lint_paths([str(broken)])}
+    assert hit != set(ALL_RULES)
+    assert {"OMP701", "OMP702", "OMP703"}.isdisjoint(hit)
 
 
 def test_cli_exit_codes():
@@ -67,12 +107,15 @@ def test_cli_exit_codes():
     assert ok.returncode == 0, ok.stdout + ok.stderr
     assert "lint OK" in ok.stdout
     bad = subprocess.run(
-        [sys.executable, "-m", "xgboost_tpu", "lint", FIXTURE,
+        [sys.executable, "-m", "xgboost_tpu", "lint", FIXTURE_DIR,
          "--no-baseline"],
         cwd=REPO, env=env, capture_output=True, text=True, timeout=300)
     assert bad.returncode == 1, bad.stdout + bad.stderr
     for rule in ALL_RULES:
         assert rule in bad.stdout, f"{rule} missing from CLI output"
+    # the summary line carries per-family counts (zeros included)
+    assert "[CC:" in bad.stderr, bad.stderr
+    assert "lint OK" in ok.stdout and "by family" in ok.stdout
 
 
 # ---------------------------------------------------------------------------

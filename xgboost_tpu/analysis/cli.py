@@ -41,6 +41,20 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _family(rule_id: str) -> str:
+    return "".join(ch for ch in rule_id if ch.isalpha())
+
+
+def _family_counts(findings) -> str:
+    """``TS:0 RH:2 ...`` over every family in the catalog (zeros
+    included, so a family silently not running is visible)."""
+    fams = sorted({_family(r) for r in ALL_RULES})
+    counts = {f: 0 for f in fams}
+    for f in findings:
+        counts[_family(f.rule)] = counts.get(_family(f.rule), 0) + 1
+    return " ".join(f"{f}:{counts[f]}" for f in fams)
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     if args.list_rules:
@@ -80,12 +94,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"no such path: {', '.join(missing)}", file=sys.stderr)
         return 2
     if args.paths:
-        from .lint import iter_python_files
+        from .lint import iter_native_files, iter_python_files
 
-        if not iter_python_files(args.paths):
-            # same trap, existing path: a dir of .cpp files (or one .cpp
-            # target) lints NOTHING and must not report a clean gate
-            print(f"no Python files under: {', '.join(args.paths)}",
+        if not iter_python_files(args.paths) \
+                and not iter_native_files(args.paths):
+            # same trap, existing path: a dir with neither .py nor .cpp
+            # targets lints NOTHING and must not report a clean gate
+            print(f"no lintable files under: {', '.join(args.paths)}",
                   file=sys.stderr)
             return 2
 
@@ -124,10 +139,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     if new:
         print(f"\n{len(new)} unsuppressed finding"
               f"{'' if len(new) == 1 else 's'} "
-              f"({len(suppressed)} baseline-suppressed). "
+              f"({len(suppressed)} baseline-suppressed) "
+              f"[{_family_counts(new)}]. "
               f"Fix them, or baseline WITH justification "
               f"(--write-baseline, then annotate).", file=sys.stderr)
         return 1
     print(f"lint OK: 0 unsuppressed findings "
-          f"({len(suppressed)} baseline-suppressed)")
+          f"({len(suppressed)} baseline-suppressed) "
+          f"[suppressed by family: {_family_counts(suppressed)}]")
     return 0

@@ -79,6 +79,21 @@ ALL_RULES = {
     "CC405": "direct kernel-backend selection outside dispatch/",
     "RS501": "direct collective call site outside collective.py",
     "RS502": "bare broad except swallow on the serving dispatch path",
+    # cross-boundary families (ffi_contract.py / omp_lint.py / drift.py)
+    "NB601": "FFI arity/attr-set drift between call site and handler",
+    "NB602": "FFI buffer dtype mismatch across the native boundary",
+    "NB603": "FFI result-count drift between call site and handler",
+    "NB604": "FFI orphan: unregistered, uncalled, undefined, or missing "
+             "from the built .so",
+    "OMP701": "OpenMP float reduction reorders accumulation",
+    "OMP702": "OpenMP atomic on a float accumulator",
+    "OMP703": "parallel-for writes a shared float array off the "
+              "induction variable",
+    "OMP704": "native TU compiled without -ffp-contract=off",
+    "DR801": "XGBTPU_* env var read in code but absent from the curated "
+             "docs",
+    "DR802": "registered metric name absent from the curated docs",
+    "DR803": "dispatch op with no impl resolvable on CPU",
 }
 
 # RS501: every collective must route through the guarded entry point
@@ -391,6 +406,34 @@ def iter_python_files(paths: Sequence[str]) -> List[str]:
         elif p.endswith(".py"):
             out.append(p)
     return out
+
+
+def iter_native_files(paths: Sequence[str]) -> List[str]:
+    """C++ TUs under ``paths`` — the NB6xx/OMP7xx scan set."""
+    out: List[str] = []
+    for p in paths:
+        p = os.path.abspath(p)
+        if os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = [d for d in sorted(dirs)
+                           if d not in ("__pycache__", ".git")]
+                for f in sorted(files):
+                    if f.endswith(".cpp"):
+                        out.append(os.path.join(root, f))
+        elif p.endswith(".cpp"):
+            out.append(p)
+    return out
+
+
+def _native_relpath(path: str, pkg_root: str) -> str:
+    """Repo-relative posix path for a TU, mirroring the module
+    convention (package files anchor at the repo root, external ones at
+    the cwd)."""
+    root_parent = os.path.dirname(pkg_root)
+    if pkg_root and os.path.commonpath([path, pkg_root]) == pkg_root:
+        return os.path.relpath(path, root_parent).replace(os.sep, "/")
+    rel = os.path.relpath(path, os.getcwd()).replace(os.sep, "/")
+    return path.replace(os.sep, "/") if rel.startswith("..") else rel
 
 
 def _collect_module(path: str, pkg_root: str) -> Optional[_Module]:
@@ -1271,6 +1314,16 @@ def lint_paths(paths: Optional[Sequence[str]] = None,
     findings += _pass_collectives(project)
     findings += _pass_round_loop_sync(project)
     findings += _pass_serving_excepts(project)
+    # cross-boundary passes (lazy imports keep the pure-AST fast path
+    # free of them when a --rules subset never asks)
+    from . import drift, ffi_contract, omp_lint
+
+    cpp = [(f, _native_relpath(f, pkg_root))
+           for f in iter_native_files(paths)]
+    compile_sites = omp_lint.collect_compile_sites(modules)
+    findings += ffi_contract.run_pass(cpp, modules, compile_sites)
+    findings += omp_lint.run_pass(cpp, modules, compile_sites)
+    findings += drift.run_pass(modules, pkg_root)
     if rules:
         findings = [f for f in findings if f.rule in rules]
     # dedupe (two detection routes can hit the same node)

@@ -29,15 +29,27 @@ _pc_lib: Optional[ctypes.CDLL] = None
 _pc_tried = False
 
 
-def _san_enabled() -> bool:
+def _san_mode() -> Optional[str]:
     """Sanitizer lane (the reference's CMake ``USE_SANITIZER`` analog):
-    ``XGBTPU_SAN=1`` builds every native library with ASan+UBSan and
-    warnings-as-errors, into separate ``.san.so`` artifacts so the lane
-    never clobbers (or reuses) production builds. A sanitized library only
-    *loads* under an ASan-preloaded process (``LD_PRELOAD=libasan.so``) —
+    ``XGBTPU_SAN=1`` (or ``=address``) builds every native library with
+    ASan+UBSan into ``.san.so`` artifacts; ``XGBTPU_SAN=thread`` builds
+    TSan ``.tsan.so`` variants instead, so the data-race lane can watch
+    the OpenMP kernels and the threaded prefetcher/checkpoint writers.
+    Separate artifact suffixes mean no lane ever clobbers (or reuses)
+    production builds. A sanitized library only *loads* under a
+    preloaded process (``LD_PRELOAD=libasan.so`` / ``libtsan.so``) —
     plain processes get the usual graceful None fallback. See
     ``tests/test_sanitizer.py`` and docs/static_analysis.md."""
-    return os.environ.get("XGBTPU_SAN") == "1"
+    v = os.environ.get("XGBTPU_SAN", "")
+    if v in ("1", "address"):
+        return "address"
+    if v == "thread":
+        return "thread"
+    return None
+
+
+def _san_enabled() -> bool:
+    return _san_mode() is not None
 
 
 _SAN_FLAGS = (
@@ -45,22 +57,30 @@ _SAN_FLAGS = (
     "-fno-omit-frame-pointer", "-g", "-Wall", "-Wextra", "-Werror",
 )
 
+# TSan and ASan are mutually exclusive in one binary, so the thread lane
+# is its own artifact. No -Werror here: the lane must instrument the FFI
+# kernels, and the jaxlib FFI headers themselves trip -Wsign-compare —
+# warning hygiene is the address lane's job.
+_TSAN_FLAGS = (
+    "-fsanitize=thread", "-fno-omit-frame-pointer", "-g",
+)
+
 
 def _lib_variant(lib_path: str) -> str:
-    """The artifact path for the active lane (``.san.so`` under
-    ``XGBTPU_SAN=1``). Single source of truth for builders AND loaders."""
-    if _san_enabled() and lib_path.endswith(".so"):
-        return lib_path[:-3] + ".san.so"
+    """The artifact path for the active lane (``.san.so`` under the
+    address lane, ``.tsan.so`` under the thread lane). Single source of
+    truth for builders AND loaders."""
+    mode = _san_mode()
+    if mode and lib_path.endswith(".so"):
+        return lib_path[:-3] + (".tsan.so" if mode == "thread"
+                                else ".san.so")
     return lib_path
 
 
-def find_libasan() -> Optional[str]:
-    """Path of the toolchain's libasan runtime (for ``LD_PRELOAD`` when
-    running a sanitized library under an uninstrumented Python), or None
-    when the toolchain can't say."""
+def _find_san_runtime(name: str) -> Optional[str]:
     try:
         out = subprocess.run(
-            ["g++", "-print-file-name=libasan.so"],
+            ["g++", f"-print-file-name={name}"],
             capture_output=True, timeout=30, check=True,
         ).stdout.decode().strip()
     except Exception:
@@ -68,18 +88,34 @@ def find_libasan() -> Optional[str]:
     return out if out and os.path.sep in out else None
 
 
+def find_libasan() -> Optional[str]:
+    """Path of the toolchain's libasan runtime (for ``LD_PRELOAD`` when
+    running a sanitized library under an uninstrumented Python), or None
+    when the toolchain can't say."""
+    return _find_san_runtime("libasan.so")
+
+
+def find_libtsan() -> Optional[str]:
+    """Path of the toolchain's libtsan runtime, for preloading the
+    thread lane the same way (``LD_PRELOAD=libtsan.so``)."""
+    return _find_san_runtime("libtsan.so")
+
+
 def _compile(src: str, lib_path: str, extra: list, timeout: int = 120) -> bool:
     """Build ``lib_path`` from ``src`` when stale (single-sourced
     staleness + existence logic for all the on-demand libraries).
-    True when a usable library exists afterwards. Under ``XGBTPU_SAN=1``
-    the caller passes a ``.san.so`` path (via ``_lib_variant``) and the
-    sanitizer/warning flags are appended here."""
+    True when a usable library exists afterwards. Under a sanitizer lane
+    the caller passes a ``.san.so``/``.tsan.so`` path (via
+    ``_lib_variant``) and the lane's flags are appended here."""
     if not os.path.exists(src):
         return os.path.exists(lib_path)  # prebuilt-only deployment
     if os.path.exists(lib_path) and             os.path.getmtime(lib_path) >= os.path.getmtime(src):
         return True
-    if _san_enabled():
+    mode = _san_mode()
+    if mode == "address":
         extra = list(extra) + list(_SAN_FLAGS)
+    elif mode == "thread":
+        extra = list(extra) + list(_TSAN_FLAGS)
     cmd = ["g++", "-shared", "-fPIC", "-o", lib_path, src] + extra
     try:
         # ``native_load`` chaos site: a scripted fault here exercises the
@@ -104,7 +140,7 @@ def get_pagecache_lib() -> Optional[ctypes.CDLL]:
         _pc_tried = True
         lp = _lib_variant(_PC_LIB)
         if not _compile(_PC_SRC, lp,
-                        ["-O3", "-std=c++17", "-pthread"]):
+                        ["-O3", "-std=c++17", "-pthread", "-ffp-contract=off"]):
             return None
         try:
             lib = ctypes.CDLL(lp)
@@ -134,7 +170,8 @@ def get_lib() -> Optional[ctypes.CDLL]:
             return _lib
         _tried = True
         lp = _lib_variant(_LIB_PATH)
-        if not _compile(_SRC, lp, ["-O3", "-march=native"]):
+        if not _compile(_SRC, lp,
+                        ["-O3", "-march=native", "-ffp-contract=off"]):
             return None
         try:
             lib = ctypes.CDLL(lp)
@@ -236,10 +273,10 @@ def get_serving_lib() -> Optional[ctypes.CDLL]:
             return _sv_lib
         _sv_tried = True
         lp = _lib_variant(_SV_LIB)
-        ok = _compile(_SV_SRC, lp,
-                      ["-O3", "-march=native", "-fopenmp"])
+        sv_flags = ["-O3", "-march=native", "-ffp-contract=off"]
+        ok = _compile(_SV_SRC, lp, sv_flags + ["-fopenmp"])
         if not ok:  # toolchains without OpenMP: single-threaded walker
-            ok = _compile(_SV_SRC, lp, ["-O3", "-march=native"])
+            ok = _compile(_SV_SRC, lp, sv_flags)
         if not ok:
             return None
         try:
@@ -301,7 +338,8 @@ def get_hist_lib() -> Optional[ctypes.CDLL]:
             return None
         lp = _lib_variant(_HB_LIB)
         if not _compile(_HB_SRC, lp,
-                        ["-O3", "-march=native", "-std=c++17", f"-I{inc}"]):
+                        ["-O3", "-march=native", "-std=c++17",
+                         "-ffp-contract=off", f"-I{inc}"]):
             return None
         try:
             _hb_lib = ctypes.CDLL(lp)
@@ -380,7 +418,8 @@ def get_sketch_lib() -> Optional[ctypes.CDLL]:
             return None
         lp = _lib_variant(_SB_LIB)
         if not _compile(_SB_SRC, lp,
-                        ["-O3", "-march=native", "-std=c++17", f"-I{inc}"]):
+                        ["-O3", "-march=native", "-std=c++17",
+                         "-ffp-contract=off", f"-I{inc}"]):
             return None
         try:
             _sb_lib = ctypes.CDLL(lp)
@@ -417,7 +456,7 @@ def build_capi() -> Optional[str]:
             sysconfig.get_config_var("VERSION") or ""
         lp = _lib_variant(_CAPI_LIB)
         if not _compile(_CAPI_SRC, lp,
-                        ["-O2", "-std=c++17", f"-I{inc}",
+                        ["-O2", "-std=c++17", "-ffp-contract=off", f"-I{inc}",
                          f'-DXGBTPU_ROOT="{repo_root}"',
                          f'-DXGBTPU_SITE="{site}"',
                          f"-L{libdir}", f"-lpython{pyver}",
