@@ -54,7 +54,7 @@ echo "=== tier 0.5: kernel dispatch report (all ops resolve on CPU) ==="
 # (ISSUE 17) must be rows in the table.
 REPORT_OUT=$(python -m xgboost_tpu dispatch-report)
 echo "$REPORT_OUT"
-for op in sketch_cuts bin_matrix tree_grow sibling_sub; do
+for op in sketch_cuts bin_matrix tree_grow sibling_sub hist_acc; do
   echo "$REPORT_OUT" | grep -q "$op" || {
     echo "dispatch-report missing op: $op"; exit 1; }
 done
@@ -63,6 +63,12 @@ done
 # 1.5x grow floor exists to prevent
 echo "$REPORT_OUT" | grep -E -q "tree_grow\s+->\s+native" || {
   echo "tree_grow does not resolve to the native whole-round kernel on CPU"
+  exit 1; }
+# the quantized histogram core (ISSUE 19) must win the accumulation
+# route on CPU — hist_acc falling back to float silently forfeits the
+# BENCH_r19 grow floor the same way a tree_grow fall-back would
+echo "$REPORT_OUT" | grep -E -q "hist_acc\s+->\s+quant" || {
+  echo "hist_acc does not resolve to the quantized core on CPU"
   exit 1; }
 
 echo "=== tier 0.75: perf regression gate (envelope + seeded self-test) ==="
@@ -361,9 +367,16 @@ for i, rec in sorted(sampled.items()):
     # CPU — the record must say so, and say the replay used subtraction
     assert gd["route"] == "tree_grow", gd
     assert gd["sibling_sub"] is True, gd
+    # ISSUE 19: the quant route won on CPU, the record attributes it and
+    # carries the round's quantiser exponents (the replay rescales with
+    # the SAME grid, so a missing/null scale means the mirror ran float)
+    assert gd["hist_acc"] == "quant", gd
+    qs = gd.get("quant_scales")
+    assert qs and set(qs) == {"g_exp", "h_exp"}, gd
+    assert all(isinstance(v, int) for v in qs.values()), qs
 print("grow attribution OK: rounds 2,4 sampled, substage sums within "
       "10% of stages.grow, all 6 levels attributed, route=tree_grow "
-      "replayed with sibling subtraction")
+      "replayed with sibling subtraction on the quant accumulation route")
 
 from xgboost_tpu.cli import cli_main
 rc = cli_main(["grow-report", run_dir])

@@ -48,3 +48,23 @@ void fixture_clean(const float* v, int64_t n, float* out, float* hist) {
         for (int64_t b = 0; b < 4; ++b) slab[b] += v[i];
     }
 }
+
+// Clean (ISSUE 19): INTEGER lanes are exempt from OMP701-703 — integer
+// addition is associative, so any reduction/merge order gives the same
+// bits (the quantized histogram engine's determinism argument). Note
+// the deliberate name reuse: 'acc' is float in fixture_reduction above,
+// int64_t here — nearest-preceding-declaration typing must keep THIS
+// reduction silent while the float one still fires.
+void fixture_quant_clean(const int32_t* q, int64_t n, int64_t* lanes,
+                         int64_t* qtotal_out) {
+    int64_t acc = 0;
+    const int64_t cell = 0;
+#pragma omp parallel for reduction(+:acc)
+    for (int64_t i = 0; i < n; ++i) {
+        acc += q[i];
+#pragma omp atomic
+        lanes[1] += q[i];
+        lanes[cell] += q[i];
+    }
+    *qtotal_out = acc;
+}

@@ -123,6 +123,45 @@ def test_grow_detail_record_on_sampled_rounds_only(monkeypatch):
         assert abs(gd["sum_s"] - sum(b["wall_s"] for b in ops)) < 1e-3
 
 
+def test_grow_detail_quant_attribution(monkeypatch):
+    """ISSUE 19: on the one-dispatch route the record attributes the
+    resolved hist_acc impl and — on the quant route — carries the round's
+    quantiser grid exponents, matching what _quant_scales computes from
+    the round's gradients."""
+    from xgboost_tpu import dispatch
+
+    monkeypatch.setenv("XGBTPU_KERNEL_PROF", "rounds=1")
+    X, y = _data()
+    xgb.train(_PARAMS, xgb.DMatrix(X, label=y), 2, verbose_eval=False)
+    rec = next(r for r in RECORDER.records()
+               if r.get("t") == "round" and "grow_detail" in r)
+    gd = rec["grow_detail"]
+    if gd["route"] != "tree_grow":
+        pytest.skip("whole-tree route not taken on this platform")
+    expect = dispatch.resolve("hist_acc").impl
+    assert gd["hist_acc"] == expect
+    if expect == "quant":
+        qs = gd["quant_scales"]
+        assert set(qs) == {"g_exp", "h_exp"}
+        assert all(isinstance(v, int) for v in qs.values()), qs
+    else:
+        assert gd["quant_scales"] is None
+
+
+def test_format_grow_detail_quant_route_note():
+    """The quant replay advertises itself and its grid in the header."""
+    rec = _fake_record()
+    rec["hist_acc"] = "quant"
+    rec["quant_scales"] = {"g_exp": 18, "h_exp": 19}
+    txt = kernelprof.format_grow_detail(rec, grow_s=0.032)
+    assert "route=tree_grow (quant replay, scales g=2^-18 h=2^-19)" \
+        in txt, txt
+    # a float-pinned run renders the sibling-sub note as before
+    rec["hist_acc"] = "float"
+    txt = kernelprof.format_grow_detail(rec, grow_s=0.032)
+    assert "(sibling-sub replay)" in txt
+
+
 def test_host_sync_counter_and_grow_spans(monkeypatch, tmp_path):
     """The seam's side channels: host_syncs_total{site=} in the metrics
     exposition, and one cat="grow" Chrome span per bracket nested under
@@ -280,3 +319,30 @@ def test_grow_report_diff(tmp_path, capsys):
     assert kernelprof.main(["--diff", a, b, "--round", "9"]) == 1
     assert "XGBTPU_KERNEL_PROF" in capsys.readouterr().err
     assert kernelprof.main(["--diff", a]) == 1  # needs exactly two sides
+
+
+def test_grow_report_diff_marks_impl_changes():
+    """ISSUE 19: a row whose resolved impl flipped between the two runs
+    (e.g. hist_acc float -> quant) carries a ``*`` marker and the table
+    footnotes the count — a route flip must be visible without eyeballing
+    the impl column."""
+    rec_a, rec_b = _fake_record(), _fake_record()
+    for op in rec_b["ops"]:
+        if op["op"] == "level_hist":
+            op["impl"] = "quant"
+
+    def _diff(ra, rb):
+        agg_a, rounds_a = kernelprof._aggregate_ops(
+            [{"grow_detail": ra}])
+        agg_b, rounds_b = kernelprof._aggregate_ops(
+            [{"grow_detail": rb}])
+        return kernelprof.format_grow_diff(
+            agg_a, rounds_a, "A", agg_b, rounds_b, "B")
+
+    txt = _diff(rec_a, rec_b)
+    line = next(ln for ln in txt.splitlines() if "level_hist" in ln)
+    assert "native->quant" in line and line.endswith(" *"), txt
+    assert "* = resolved impl changed between runs (1 row(s))" in txt
+    # identical impls: no marker, no footnote
+    clean = _diff(rec_a, _fake_record())
+    assert "*" not in clean, clean

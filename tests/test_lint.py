@@ -85,6 +85,28 @@ def test_cross_boundary_rules_fire_exactly_once_each():
         "the consistent fixture_ok pair must stay silent"
 
 
+def test_omp_integer_lanes_exempt():
+    """The ISSUE 19 exemption: reductions/atomics/shared writes over
+    INTEGER lanes (the quant engine's int64 accumulators) must NOT fire
+    OMP701-703 — integer adds are associative, so thread count cannot
+    change the bits. The fixture reuses the name 'acc' (float in
+    fixture_reduction, int64_t in fixture_quant_clean), pinning the
+    nearest-preceding-declaration typing: the float reduction still
+    fires exactly once, the integer one stays silent."""
+    findings = lint_paths([FIXTURE_OMP_CPP])
+    omp = [f for f in findings if f.rule in ("OMP701", "OMP702",
+                                             "OMP703")]
+    assert len([f for f in omp if f.rule == "OMP701"]) == 1
+    assert not any(f.symbol in ("lanes", "qtotal_out") for f in omp), \
+        [f.render() for f in omp]
+    # no finding may point into fixture_quant_clean at all
+    src = open(FIXTURE_OMP_CPP).read()
+    first_clean_line = src[:src.index("fixture_quant_clean")].count(
+        "\n") + 1
+    assert not any(f.line >= first_clean_line for f in omp), \
+        [f.render() for f in omp]
+
+
 def test_gate_self_check_catches_removed_fixture(tmp_path):
     """Deleting one fixture file kills its rules' seeds: the every-rule
     assertion (the CI self-check) must detect the hole."""

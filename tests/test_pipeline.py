@@ -255,6 +255,36 @@ def test_reduce_histogram_exact_requantization():
         collective.reduce_histogram(zeros, site="unit_zero"), zeros)
 
 
+def test_reduce_histogram_prequantized_scale():
+    """The ISSUE 19 wire path: ``scale=`` marks an already-quantized
+    integer payload (the quant engine's fixed-point lanes on the shared
+    per-round grid). No grid detection, no requantization round-trip —
+    the integers ship as-is, the sum runs in int64, and ONE dequantizing
+    multiply at the end yields f32. Exact even where the generic f32
+    path would be ineligible (magnitudes past the int16 window)."""
+    rng = np.random.RandomState(3)
+    E = 18
+    q = rng.randint(-(1 << 20), 1 << 20, (8, 4, 16)).astype(np.int32)
+    out = collective.reduce_histogram(q, site="unit_preq",
+                                      scale=2.0 ** -E)
+    assert out.dtype == np.float32
+    ref = (q.astype(np.float64) * 2.0 ** -E).astype(np.float32)
+    assert np.array_equal(out, ref)
+
+    # int64 lanes (the engine's merge dtype) take the same path
+    q64 = q.astype(np.int64) * 3
+    out64 = collective.reduce_histogram(q64, site="unit_preq64",
+                                        scale=2.0 ** -E)
+    ref64 = (q64.astype(np.float64) * 2.0 ** -E).astype(np.float32)
+    assert out64.dtype == np.float32 and np.array_equal(out64, ref64)
+
+    # a float payload with scale= is a contract violation, not a silent
+    # requantization
+    with pytest.raises(TypeError, match="integer payload"):
+        collective.reduce_histogram(
+            q.astype(np.float32), site="unit_preq_bad", scale=2.0 ** -E)
+
+
 def test_reduce_histogram_wire_narrows_bytes():
     """The accounted collective bytes for an eligible payload are the
     NARROW wire bytes (int16), not the naive f32 size."""

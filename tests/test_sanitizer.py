@@ -314,6 +314,48 @@ def test_tsan_training_round_trip(tmp_path):
             w.wait(ckpt_dir)
             p = bst.inplace_predict(X[:64], predict_type="margin")
             assert np.asarray(p).shape[0] == 64
+
+            # ISSUE 19: drive the quant engine's row-slab parallel
+            # accumulation directly — n spans 3 slabs of kSlabRows=4096,
+            # and OMP_NUM_THREADS=4 (set by the parent) puts multiple
+            # threads on disjoint slabs merging into the shared int64
+            # lanes. TSan adjudicates the slab-partial writes and the
+            # merge; two runs must also be byte-identical (the integer
+            # determinism contract under the sanitizer's scheduler
+            # perturbation).
+            from types import SimpleNamespace
+
+            import jax.numpy as jnp
+
+            from xgboost_tpu.tree import tree_kernel
+
+            # the paged training above drives the per-level kernels; the
+            # whole-tree entry registers lazily on first use
+            assert tree_kernel.tree_ffi_ready(), \\
+                "tsan whole-tree kernel did not register"
+            rq = np.random.RandomState(7)
+            nq, Fq, Bq = 12288, 6, 16
+            binsq = jnp.asarray(
+                rq.randint(0, Bq + 1, (nq, Fq)).astype(np.uint8))
+            ghq = jnp.asarray(
+                rq.randn(nq, 2).astype(np.float32) ** 2 + 0.1)
+            cutsq = jnp.asarray(
+                np.sort(rq.randn(Fq, Bq).astype(np.float32), axis=1))
+            maskq = jnp.ones((Fq,), bool)
+            G0 = jnp.float32(np.asarray(ghq)[:, 0].sum())
+            H0 = jnp.float32(np.asarray(ghq)[:, 1].sum())
+            splitq = SimpleNamespace(reg_lambda=1.0, reg_alpha=0.0,
+                                     max_delta_step=0.0,
+                                     min_child_weight=1.0)
+            runs = []
+            for _ in range(2):
+                out = tree_kernel.tree_grow_native(
+                    binsq, ghq, cutsq, maskq, G0, H0, max_depth=4,
+                    B=Bq, sibling_sub=True, hist_acc="quant",
+                    split=splitq)
+                runs.append([np.asarray(a).tobytes() for a in out])
+            assert runs[0] == runs[1], \\
+                "quant slab accumulation not deterministic under TSan"
             print("TSAN DRIVE OK")
         """))
 
@@ -329,6 +371,9 @@ def test_tsan_training_round_trip(tmp_path):
         ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else []))
     env["LD_PRELOAD"] = libtsan
     env["XGBTPU_SAN"] = "thread"
+    # more threads than this box has cores: the row-slab quant
+    # accumulation must interleave for TSan to have races to adjudicate
+    env["OMP_NUM_THREADS"] = "4"
     env["TSAN_OPTIONS"] = (
         f"suppressions={supp}:ignore_noninstrumented_modules=1:"
         f"exitcode=66:history_size=4")

@@ -27,6 +27,14 @@ the promise by *reordering float accumulation across threads*:
   (and fixture stubs shaped like them), with constant folding through
   local/module assignments and ``flags + [...]`` concatenation.
 
+INTEGER lanes are exempt from OMP701–703 (ISSUE 19): the quantized
+histogram engine accumulates in int32/int64 lanes precisely BECAUSE
+integer addition is associative — any reduction/merge order gives the
+same bits, so thread count cannot change the result. Typing is by
+nearest preceding declaration (``_type_env``), so a TU that hosts both
+the float core and the integer engine can even reuse a name across
+lanes without false findings.
+
 All OMP7xx findings key on stable symbols (the reduction variable, the
 written array, the TU basename) so baseline entries survive line churn.
 """
@@ -173,21 +181,52 @@ def collect_compile_sites(modules) -> List[CompileSite]:
 # ---------------------------------------------------------------------------
 
 
-def _float_names(text: str) -> Set[str]:
-    """Identifiers declared float/double anywhere in the TU (values,
-    pointers, arrays, vector<float>) — the cheap type environment the
-    pragma checks consult."""
-    out: Set[str] = set()
-    for m in re.finditer(
-            r"\b(?:float|double)\s*[*&]?\s*(\w+)\s*[=;,)\[]", text):
-        out.add(m.group(1))
-    for m in re.finditer(
-            r"\bstd::vector<\s*(?:float|double)\s*>\s*(\w+)", text):
-        out.add(m.group(1))
-    for m in re.finditer(
-            r"\b(?:float|double)\s*\*\s*(?:const\s+)?(\w+)", text):
-        out.add(m.group(1))
-    return out
+_INT_KW = (r"(?:unsigned\s+)?(?:int|long(?:\s+long)?|short|size_t|"
+           r"(?:std::)?u?int\d+_t)")
+
+
+def _type_env(text: str) -> Dict[str, List[Tuple[int, str]]]:
+    """name -> [(decl char offset, kind)] sorted by position, kind in
+    {"float", "int"} — the cheap positional type environment the pragma
+    checks consult. Positional because the quantized histogram engine
+    (ISSUE 19) sits in the same TU as the float core and may reuse a
+    name across lanes: the NEAREST PRECEDING declaration governs, so an
+    ``int64_t acc`` reduction stays exempt even when a ``float acc``
+    exists earlier in the file (integer adds are associative — thread
+    count cannot change the result — which is the engine's entire
+    determinism argument)."""
+    env: Dict[str, List[Tuple[int, str]]] = {}
+
+    def scan(pattern: str, kind: str) -> None:
+        for m in re.finditer(pattern, text):
+            env.setdefault(m.group(1), []).append((m.start(), kind))
+
+    scan(r"\b(?:float|double)\s*[*&]?\s*(\w+)\s*[=;,)\[]", "float")
+    scan(r"\bstd::vector<\s*(?:float|double)\s*>\s*(\w+)", "float")
+    scan(r"\b(?:float|double)\s*\*\s*(?:const\s+)?(\w+)", "float")
+    scan(r"\b" + _INT_KW + r"\s*[*&]?\s*(\w+)\s*[=;,)\[]", "int")
+    scan(r"\bstd::vector<\s*" + _INT_KW + r"\s*>\s*(\w+)", "int")
+    scan(r"\b" + _INT_KW + r"\s*\*\s*(?:const\s+)?(\w+)", "int")
+    for decls in env.values():
+        decls.sort()
+    return env
+
+
+def _is_float_at(env: Dict[str, List[Tuple[int, str]]], name: str,
+                 pos: int) -> bool:
+    """Whether ``name`` is float-typed at char offset ``pos``: the
+    nearest preceding declaration decides; a name only declared later
+    falls back to its first declaration; an undeclared name is not
+    float (the original conservative behavior)."""
+    decls = env.get(name)
+    if not decls:
+        return False
+    kind = decls[0][1]
+    for p, k in decls:
+        if p > pos:
+            break
+        kind = k
+    return kind == "float"
 
 
 def _joined_pragmas(text: str) -> List[Tuple[int, str, int]]:
@@ -279,7 +318,9 @@ def _body_locals(body: str) -> Set[str]:
 
 
 def _check_parallel_for(text: str, relpath: str, pragma_line: int,
-                        after: int, floats: Set[str]) -> List[Finding]:
+                        after: int,
+                        env: Dict[str, List[Tuple[int, str]]]
+                        ) -> List[Finding]:
     parsed = _for_loop_after(text, after)
     if parsed is None:
         return []
@@ -293,7 +334,11 @@ def _check_parallel_for(text: str, relpath: str, pragma_line: int,
             r"(\w+)\s*\[((?:[^\[\]]|\[[^\]]*\])*)\]\s*"
             r"(\+=|-=|\*=|/=|=)(?!=)", body):
         base, index, _op = m.group(1), m.group(2), m.group(3)
-        if base not in floats or base in derived:
+        # integer-lane targets are exempt: racing integer adds would
+        # still be a bug, but the determinism contract this rule guards
+        # (float accumulation order) does not apply to them
+        if not _is_float_at(env, base, b0 + m.start()) \
+                or base in derived:
             continue
         idx_names = set(re.findall(r"[A-Za-z_]\w*", index))
         if idx_names & derived:
@@ -315,13 +360,13 @@ def _analyze_tu(path: str, relpath: str) -> List[Finding]:
             text = f.read()
     except OSError:
         return []
-    floats = _float_names(text)
+    env = _type_env(text)
     findings: List[Finding] = []
     for line, directive, after in _joined_pragmas(text):
         for rm in re.finditer(r"reduction\s*\(\s*[^:()]+:\s*([^)]*)\)",
                               directive):
             for var in (v.strip() for v in rm.group(1).split(",")):
-                if var and var in floats:
+                if var and _is_float_at(env, var, after):
                     findings.append(Finding(
                         "OMP701", relpath, line, var,
                         f"OpenMP reduction over float '{var}' combines "
@@ -330,7 +375,7 @@ def _analyze_tu(path: str, relpath: str) -> List[Finding]:
         if re.search(r"\batomic\b", directive):
             stmt = text[after:after + 200].lstrip()
             lm = re.match(r"([A-Za-z_]\w*)", stmt)
-            if lm and lm.group(1) in floats:
+            if lm and _is_float_at(env, lm.group(1), after):
                 findings.append(Finding(
                     "OMP702", relpath, line, lm.group(1),
                     f"omp atomic on float '{lm.group(1)}' is atomic but "
@@ -338,7 +383,7 @@ def _analyze_tu(path: str, relpath: str) -> List[Finding]:
         if re.search(r"\bfor\b", directive) \
                 and not re.search(r"\batomic\b", directive):
             findings += _check_parallel_for(
-                text, relpath, line, after, floats)
+                text, relpath, line, after, env)
     return findings
 
 

@@ -197,7 +197,7 @@ def _grid_lsb_exp(arr: np.ndarray) -> float:
     return float((exp - 53 + np.log2(low_bit)).min())
 
 
-def reduce_histogram(data, *, site: str):
+def reduce_histogram(data, *, site: str, scale: Optional[float] = None):
     """Guarded cross-process SUM of a histogram-shaped array with a
     hierarchically agreed, lossless-narrowed wire format. Identity
     single-process (bytes still accounted at the narrow width, so the
@@ -211,8 +211,20 @@ def reduce_histogram(data, *, site: str):
     histograms — "where bin counts allow"); the wire sum runs in int64 and
     dequantizes to the exact mathematical sum (exact in f32 up to 2^24
     grid units). Ineligible payloads ship unchanged. Either way the
-    result is the exact sum — pinned by the exact-requantization test."""
+    result is the exact sum — pinned by the exact-requantization test.
+
+    ``scale`` marks an ALREADY-quantized integer payload (ISSUE 19: the
+    hist_acc=quant engine's fixed-point histogram with its shared
+    per-round grid, e.g. ``2.0 ** -E``): the values ship as the integers
+    they already are — no grid detection, no requantization round-trip —
+    the wire sum runs in int64, and the result dequantizes once at the
+    end to f32 (``sum * scale``). All ranks share the round's quantiser,
+    so the integer wire sum IS the exact fixed-point sum."""
     arr = np.asarray(data)
+    if scale is not None and arr.dtype.kind not in "iu":
+        raise TypeError(
+            f"reduce_histogram(scale=...) requires an integer payload "
+            f"(pre-quantized lanes), got {arr.dtype}")
     world = get_world_size()
     is_int = arr.dtype.kind in "iu"
     m_local = float(np.abs(arr.astype(np.float64)).max()) if arr.size else 0.0
@@ -224,7 +236,7 @@ def reduce_histogram(data, *, site: str):
         glsb_e = float(np.asarray(meta)[:, 1].min())
     else:
         gmax, glsb_e = m_local, e_local
-    wire_dt, scale = arr.dtype, None
+    wire_dt, requant = arr.dtype, None
     if is_int:
         for dt in (np.int16, np.int32):
             if np.dtype(dt).itemsize < arr.dtype.itemsize \
@@ -233,11 +245,11 @@ def reduce_histogram(data, *, site: str):
                 break
     elif arr.dtype == np.float32:
         if gmax == 0.0:
-            wire_dt, scale = np.dtype(np.int16), 1.0
+            wire_dt, requant = np.dtype(np.int16), 1.0
         elif np.isfinite(glsb_e) and gmax / 2.0 ** glsb_e < 2 ** 15:
-            wire_dt, scale = np.dtype(np.int16), float(2.0 ** glsb_e)
-    if scale is not None:
-        wire = np.rint(arr.astype(np.float64) / scale).astype(wire_dt)
+            wire_dt, requant = np.dtype(np.int16), float(2.0 ** glsb_e)
+    if requant is not None:
+        wire = np.rint(arr.astype(np.float64) / requant).astype(wire_dt)
     elif wire_dt != arr.dtype:
         wire = arr.astype(wire_dt)
     else:
@@ -249,8 +261,12 @@ def reduce_histogram(data, *, site: str):
         total = gathered.astype(np.int64).sum(axis=0)
     else:
         total = gathered.sum(axis=0)
+    if requant is not None:
+        return (total.astype(np.float64) * requant).astype(arr.dtype)
     if scale is not None:
-        return (total.astype(np.float64) * scale).astype(arr.dtype)
+        # pre-quantized payload: the only float op in the whole exchange
+        # is this one dequantizing multiply at the very end
+        return (total.astype(np.float64) * float(scale)).astype(np.float32)
     if arr.dtype.kind in "iu":
         # integer sums keep int64 (np.sum's promotion — the dtype the
         # unquantized allreduce path always returned): narrowing back to

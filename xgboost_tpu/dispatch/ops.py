@@ -15,6 +15,8 @@ op                    implementations (preference order)         capability
 ====================  =========================================  =============
 ``tree_grow``         native (CPU, whole-round kernel) > level   —
 ``sibling_sub``       on > off (histogram subtraction trick)     —
+``hist_acc``          CPU: quant > float (integer histogram      —
+                      accumulation inside the whole-tree kernel)
 ``level_hist``        pallas > native (CPU) > xla                —
 ``level_partition``   native (CPU) > xla                         —
 ``level_update``      xla (single impl: shared split eval)       —
@@ -109,6 +111,17 @@ set_report_ctx("tree_grow", lambda: Ctx(
 register("sibling_sub", "on", pref=(("*", 0),))
 register("sibling_sub", "off", pref=(("*", 1),))
 set_report_ctx("sibling_sub", lambda: Ctx(platform=_platform()))
+
+
+# Histogram accumulation inside the whole-tree kernel (ISSUE 19): the
+# fixed-point integer engine (per-node row lists, packed int32 gradient
+# lanes, int64 merge — thread-count invariant by construction) leads on
+# CPU; ``float`` is the r17 f32 core and the bit-identity kill switch —
+# pinning BOTH ``hist_acc=float`` and ``sibling_sub=off`` makes the
+# whole-tree kernel byte-identical to the per-level native path.
+register("hist_acc", "quant", pref=(("cpu", 0), ("*", 2)))
+register("hist_acc", "float", pref=(("*", 1),))
+set_report_ctx("hist_acc", lambda: Ctx(platform=_platform()))
 
 
 def _pallas_level_applicable(ctx: Ctx) -> bool:

@@ -32,11 +32,31 @@
 //    off switch (XGBTPU_SIBLING_SUB=0) pins the whole kernel bit-identical
 //    to the per-level native path.
 //
+//  * Quantized histogram engine (attr `hist_acc`, ISSUE 19): with
+//    hist_acc=1 ("quant") the histogram core runs on fixed-point
+//    quantized gradients — one per-round quantiser (power-of-two scales
+//    from the global max |g| / |h|) packs (g, h) into the two int32
+//    lanes of one int64; rows stream through per-node row lists built by
+//    a stable counting sort (only rows of BUILT siblings are touched, vs
+//    all n masked on the float path) into per-(node, slab) packed
+//    partials whose lane sums provably fit int32 (kSlabRows * 2^kQBits =
+//    2^30), then widen into an int64 level histogram. Integer addition
+//    is associative, so accumulation order — and therefore OpenMP thread
+//    count and slab schedule — cannot change the result by construction;
+//    sibling derivation (parent - built) is EXACT in the integer domain.
+//    Dequantization to f32 happens once per level, at eval time, so
+//    eval_level's math is unchanged. hist_acc=0 ("float") keeps the r17
+//    float core untouched — the bit-identity kill switch.
+//
 // `XgbtpuHbLevelSub` exposes ONE level of the same machinery (partition +
 // subtraction histogram) for the kernelprof mirror: sampled rounds replay
 // the round per-level for attribution, and because the mirror kernel
 // shares these exact core loops, its histograms match the in-kernel ones
-// bit-for-bit by construction.
+// bit-for-bit by construction. `XgbtpuHbLevelQuant` is its quant-route
+// twin: one level of partition + quantize + row-list build + integer
+// accumulate (+ integer sibling derive), carrying the previous level's
+// int64 histogram across calls as packed int32 word pairs (an f32
+// carry would drop bits once sums exceed 24 mantissa bits).
 //
 // Blocking parameters: feature blocks are sized so one block's histogram
 // slab ([fb, 2K, B] f32) fits the kHistL2Budget bytes (256 KiB — a
@@ -225,6 +245,240 @@ void derive_siblings(const float* prev, float* cur, int64_t F, int64_t B,
     }
 }
 
+// ---- fixed-point quantized gradient engine (ISSUE 19) ------------------
+//
+// One per-round quantiser: per-lane power-of-two scales 2^Eg / 2^Eh with
+// E = kQBits - e where frexp(max|x|) = m * 2^e (m in [0.5, 1)), so every
+// quantized magnitude is <= 2^kQBits. Count-valued gradients (small
+// integers) land exactly on the grid whenever E >= 0 — the PR-13
+// power-of-two-grid argument — so quantize -> sum -> dequantize
+// reproduces the float path bit-for-bit on such data. (g, h) pack into
+// the two int32 lanes of one int64 (g high, h low); a slab of kSlabRows
+// rows keeps each lane's partial within kSlabRows * 2^kQBits = 2^30 <
+// INT32_MAX, so packed lane adds cannot carry across lanes and every
+// per-slab partial is exact. Integer addition is associative, so ANY
+// merge order — and therefore any OpenMP thread count or slab schedule —
+// produces identical histograms by construction: the determinism the
+// OMP701-703 rules forbid float reductions to claim.
+
+constexpr int64_t kQBits = 18;       // |q| <= 2^18 per lane
+constexpr int64_t kSlabRows = 4096;  // 4096 * 2^18 = 2^30 < INT32_MAX
+constexpr int64_t kPrefetchAhead = 16;
+
+struct QScale {
+    int eg, eh;     // grid exponents: q = rint(x * 2^e)
+    double sg, sh;  // 2^eg, 2^eh (quantize)
+    double ig, ih;  // 2^-eg, 2^-eh (dequantize)
+};
+
+inline int grid_exp(double maxabs) {
+    if (!(maxabs > 0.0)) return 0;  // all-zero lane: any grid is exact
+    int e;
+    std::frexp(maxabs, &e);  // maxabs = m * 2^e, m in [0.5, 1)
+    return (int)kQBits - e;
+}
+
+// Scales from the global max |g| / |h| — a serial scan (max is exact and
+// order-independent, but the lint's reduction rules are regex-level, and
+// one pass over 2n floats is noise next to the histogram work).
+QScale compute_qscale(const float* gh, int64_t n) {
+    double mg = 0.0, mh = 0.0;
+    for (int64_t i = 0; i < n; ++i) {
+        const double g = std::fabs((double)gh[2 * i]);
+        const double h = std::fabs((double)gh[2 * i + 1]);
+        if (std::isfinite(g) && g > mg) mg = g;
+        if (std::isfinite(h) && h > mh) mh = h;
+    }
+    QScale q;
+    q.eg = grid_exp(mg);
+    q.eh = grid_exp(mh);
+    q.sg = std::ldexp(1.0, q.eg);
+    q.sh = std::ldexp(1.0, q.eh);
+    q.ig = std::ldexp(1.0, -q.eg);
+    q.ih = std::ldexp(1.0, -q.eh);
+    return q;
+}
+
+// Pack quantized (g, h) into one int64: g in the high 32 bits, h in the
+// low 32. Lane partials stay within int32 per slab (bound above), so
+// packed adds never carry between lanes and unpacking recovers the
+// exact per-lane sums.
+inline int64_t pack_q(int32_t qg, int32_t qh) {
+    return ((int64_t)qg << 32) + (int64_t)qh;
+}
+
+inline void unpack_q(int64_t v, int64_t* qg, int64_t* qh) {
+    const int32_t h = (int32_t)(uint32_t)(v & 0xffffffffLL);
+    *qh = (int64_t)h;
+    *qg = (v - (int64_t)h) >> 32;
+}
+
+// Quantize every row once per round (disjoint writes; non-finite
+// gradients quantize to 0 — the dispatch envelope never routes such
+// data here, but the kernel must not exhibit UB on it).
+void quantize_rows(const float* gh, int64_t n, const QScale& q,
+                   int64_t* qrow) {
+#ifdef _OPENMP
+#pragma omp parallel for schedule(static) if (n >= 8192)
+#endif
+    for (int64_t i = 0; i < n; ++i) {
+        const double g = (double)gh[2 * i] * q.sg;
+        const double h = (double)gh[2 * i + 1] * q.sh;
+        const int32_t qg = std::isfinite(g) ? (int32_t)std::llrint(g) : 0;
+        const int32_t qh = std::isfinite(h) ? (int32_t)std::llrint(h) : 0;
+        qrow[i] = pack_q(qg, qh);
+    }
+}
+
+// Stable counting sort of this level's rows into per-slot row lists off
+// the `count_rows` counts: rows ascending per slot, unbuilt slots empty
+// (their rows are never touched — with sibling subtraction that is
+// <= half of n at depth >= 1, vs all n masked on the float path).
+// rl_start has K + 1 entries; rows receives the concatenated lists.
+void build_row_lists(const int64_t* counts, const uint8_t* build_mask,
+                     const int32_t* pos, int64_t n, int64_t off, int64_t K,
+                     int64_t* rl_start, int32_t* rows) {
+    int64_t total = 0;
+    for (int64_t s = 0; s < K; ++s) {
+        rl_start[s] = total;
+        if (!build_mask || build_mask[s]) total += counts[s];
+    }
+    rl_start[K] = total;
+    std::vector<int64_t> cursor(rl_start, rl_start + K);
+    for (int64_t i = 0; i < n; ++i) {
+        const int64_t s = (int64_t)pos[i] - off;
+        if (s < 0 || s >= K) continue;
+        if (build_mask && !build_mask[s]) continue;
+        rows[cursor[s]++] = (int32_t)i;
+    }
+}
+
+// Integer histogram accumulation: per-(slot, slab) tasks, each owning
+// ONE packed [F, B] int64 partial slab (L2-resident: F * B * 8 bytes),
+// with software prefetch on upcoming rows' bin lines. Phase 2 widens
+// each slab's int32 lanes into the int64 level histogram hq [F, 2K, B]
+// (g at [f, s, b], h at [f, K + s, b] — the float hist layout). Slots
+// own disjoint hq slabs and integer adds are exact, so both phases are
+// thread-count invariant for ANY schedule.
+template <typename BinT>
+void accumulate_level_quant(const BinT* bins, const int64_t* qrow,
+                            const int32_t* rows, const int64_t* rl_start,
+                            int64_t F, int64_t B, int64_t K,
+                            const uint8_t* build_mask, int64_t* hq,
+                            std::vector<int64_t>& scratch) {
+    struct Task {
+        int32_t slot;
+        int64_t beg, end;
+    };
+    std::vector<Task> tasks;
+    std::vector<int64_t> slot_t0((size_t)(K + 1));
+    for (int64_t s = 0; s < K; ++s) {
+        slot_t0[s] = (int64_t)tasks.size();
+        if (build_mask && !build_mask[s]) continue;
+        for (int64_t b = rl_start[s]; b < rl_start[s + 1]; b += kSlabRows) {
+            tasks.push_back(
+                {(int32_t)s, b, std::min(rl_start[s + 1], b + kSlabRows)});
+        }
+    }
+    slot_t0[K] = (int64_t)tasks.size();
+    const int64_t ntasks = (int64_t)tasks.size();
+    const int64_t slab_sz = F * B;
+    const int64_t total = rl_start[K];
+    scratch.assign((size_t)(ntasks * slab_sz), 0);
+#ifdef _OPENMP
+#pragma omp parallel for schedule(dynamic, 1) if (ntasks > 1 && total >= 8192)
+#endif
+    for (int64_t t = 0; t < ntasks; ++t) {
+        int64_t* slab = scratch.data() + t * slab_sz;
+        const int64_t beg = tasks[t].beg, end = tasks[t].end;
+        for (int64_t idx = beg; idx < end; ++idx) {
+            if (idx + kPrefetchAhead < end) {
+                const int64_t rp = rows[idx + kPrefetchAhead];
+                __builtin_prefetch(bins + rp * F, 0, 1);
+                __builtin_prefetch(qrow + rp, 0, 1);
+            }
+            const int64_t i = rows[idx];
+            const int64_t q = qrow[i];
+            const BinT* br = bins + i * F;
+            for (int64_t f = 0; f < F; ++f) {
+                const int64_t bv = br[f];
+                if (bv >= B) continue;  // missing: recovered at eval
+                slab[f * B + bv] += q;
+            }
+        }
+    }
+    const int64_t fs = 2 * K * B;
+#ifdef _OPENMP
+#pragma omp parallel for schedule(static) if (K >= 8)
+#endif
+    for (int64_t s = 0; s < K; ++s) {
+        for (int64_t t = slot_t0[s]; t < slot_t0[s + 1]; ++t) {
+            const int64_t* slab = scratch.data() + t * slab_sz;
+            for (int64_t f = 0; f < F; ++f) {
+                int64_t* hg = hq + f * fs + s * B;
+                int64_t* hh = hg + K * B;
+                const int64_t* sl = slab + f * B;
+                for (int64_t b = 0; b < B; ++b) {
+                    int64_t qg, qh;
+                    unpack_q(sl[b], &qg, &qh);
+                    hg[b] += qg;
+                    hh[b] += qh;
+                }
+            }
+        }
+    }
+}
+
+// Integer-domain sibling derivation: parent - built per cell, EXACT for
+// any data (each row's quantized pair is fixed and the partition is
+// exact — stronger than the float path's ~1 ulp claim).
+void derive_siblings_quant(const int64_t* prev, int64_t* cur, int64_t F,
+                           int64_t B, int64_t K, int64_t Kp,
+                           const int64_t* counts) {
+    const int64_t fs_cur = 2 * K * B, fs_prev = 2 * Kp * B;
+#ifdef _OPENMP
+#pragma omp parallel for schedule(static) if (F >= 8)
+#endif
+    for (int64_t f = 0; f < F; ++f) {
+        for (int64_t j = 0; j < Kp; ++j) {
+            const int64_t sl = 2 * j, sr = 2 * j + 1;
+            if (counts[sl] + counts[sr] == 0) continue;
+            const int64_t built = counts[sl] <= counts[sr] ? sl : sr;
+            const int64_t other = sl + sr - built;
+            const int64_t* pg = prev + f * fs_prev + j * B;
+            const int64_t* ph = pg + Kp * B;
+            const int64_t* bg = cur + f * fs_cur + built * B;
+            const int64_t* bh = bg + K * B;
+            int64_t* og = cur + f * fs_cur + other * B;
+            int64_t* oh = og + K * B;
+            for (int64_t b = 0; b < B; ++b) {
+                og[b] = pg[b] - bg[b];
+                oh[b] = ph[b] - bh[b];
+            }
+        }
+    }
+}
+
+// Dequantize one level's int64 histogram to the f32 layout eval_level
+// consumes: a double multiply by the exact power of two, then one f32
+// rounding — bit-identical to the float path on count-valued data
+// (where both sides hold the same exact integers).
+void dequantize_level(const int64_t* hq, const QScale& q, int64_t F,
+                      int64_t B, int64_t K, float* hist) {
+    const int64_t fs = 2 * K * B, half = K * B;
+#ifdef _OPENMP
+#pragma omp parallel for schedule(static) if (F >= 8)
+#endif
+    for (int64_t f = 0; f < F; ++f) {
+        const int64_t* hrow = hq + f * fs;
+        float* out = hist + f * fs;
+        for (int64_t c = 0; c < half; ++c)
+            out[c] = (float)((double)hrow[c] * q.ig);
+        for (int64_t c = half; c < fs; ++c)
+            out[c] = (float)((double)hrow[c] * q.ih);
+    }
+}
+
 // Split evaluation for one level — a sequential-association port of
 // `_level_update` (grow_fused.py). Scans candidates dir-major then
 // feature then bin with first-max/first-NaN argmax semantics matching
@@ -384,12 +638,83 @@ void tree_grow_loop(const BinT* bins, const float* gh, const float* cuts,
                    ddef.data(), n, F, B, Kp, poff);
 }
 
+// Quant-route twin of tree_grow_loop: partition / eval / heap update are
+// the SAME code; only the histogram core differs (quantize once per
+// round, per-node row lists, packed integer slabs, int64 level
+// histograms, dequantize at eval). Row lists are built on BOTH sub
+// settings — streaming only in-level rows replaces the float path's
+// full-n masked scan.
+template <typename BinT>
+void tree_grow_loop_quant(const BinT* bins, const float* gh,
+                          const float* cuts, const int32_t* fmask, float G0,
+                          float H0, int64_t n, int64_t F, int64_t B,
+                          int64_t D, bool sub, const SplitP& p, int32_t* pos,
+                          bool* is_split, int32_t* feature,
+                          int32_t* split_bin, float* split_cond,
+                          bool* default_left, float* node_g, float* node_h,
+                          float* node_w, float* loss_chg) {
+    const int64_t max_nodes = (1LL << (D + 1)) - 1;
+    node_g[0] = G0;
+    node_h[0] = H0;
+    node_w[0] = calc_weight_c(G0, H0, p);
+    const int64_t Km = 1LL << (D - 1);
+    const QScale qs = compute_qscale(gh, n);
+    std::vector<int64_t> qrow((size_t)n);
+    quantize_rows(gh, n, qs, qrow.data());
+    std::vector<int64_t> hq_a((size_t)(F * 2 * Km * B));
+    std::vector<int64_t> hq_b((size_t)(F * 2 * Km * B));
+    std::vector<float> histf((size_t)(F * 2 * Km * B));
+    int64_t* cur = hq_a.data();
+    int64_t* prev = hq_b.data();
+    std::vector<int64_t> counts((size_t)(2 * Km));
+    std::vector<int64_t> rl_start((size_t)(2 * Km + 1));
+    std::vector<int32_t> rows((size_t)n);
+    std::vector<int64_t> scratch;
+    std::vector<uint8_t> bmask((size_t)(2 * Km));
+    std::vector<uint8_t> disp((size_t)Km), ddef((size_t)Km);
+    std::vector<int32_t> dfeat((size_t)Km), dbin((size_t)Km);
+    for (int64_t d = 0; d < D; ++d) {
+        const int64_t K = 1LL << d, off = K - 1;
+        const int64_t Kp = K >> 1, poff = Kp - 1;
+        if (d > 0) {
+            snapshot_decisions(is_split, feature, split_bin, default_left,
+                               poff, Kp, disp.data(), dfeat.data(),
+                               dbin.data(), ddef.data());
+            partition_rows(bins, pos, disp.data(), dfeat.data(), dbin.data(),
+                           ddef.data(), n, F, B, Kp, poff);
+        }
+        std::memset(cur, 0, (size_t)(F * 2 * K * B) * sizeof(int64_t));
+        count_rows(pos, n, off, K, counts.data());
+        const uint8_t* mask = nullptr;
+        if (sub && d >= 1) {
+            plan_siblings(counts.data(), Kp, bmask.data());
+            mask = bmask.data();
+        }
+        build_row_lists(counts.data(), mask, pos, n, off, K, rl_start.data(),
+                        rows.data());
+        accumulate_level_quant(bins, qrow.data(), rows.data(),
+                               rl_start.data(), F, B, K, mask, cur, scratch);
+        if (mask) derive_siblings_quant(prev, cur, F, B, K, Kp,
+                                        counts.data());
+        dequantize_level(cur, qs, F, B, K, histf.data());
+        eval_level(histf.data(), cuts, fmask, F, B, K, off, p, is_split,
+                   feature, split_bin, split_cond, default_left, node_g,
+                   node_h, node_w, loss_chg, max_nodes);
+        std::swap(cur, prev);
+    }
+    const int64_t Kp = 1LL << (D - 1), poff = Kp - 1;
+    snapshot_decisions(is_split, feature, split_bin, default_left, poff, Kp,
+                       disp.data(), dfeat.data(), dbin.data(), ddef.data());
+    partition_rows(bins, pos, disp.data(), dfeat.data(), dbin.data(),
+                   ddef.data(), n, F, B, Kp, poff);
+}
+
 ffi::Error TreeGrowImpl(
     ffi::AnyBuffer bins, ffi::Buffer<ffi::F32> gh,
     ffi::Buffer<ffi::F32> cut_values, ffi::Buffer<ffi::S32> tree_mask,
     ffi::Buffer<ffi::F32> G0, ffi::Buffer<ffi::F32> H0, int64_t max_depth,
-    int64_t B, int64_t sibling_sub, float reg_lambda, float reg_alpha,
-    float max_delta_step, float min_child_weight,
+    int64_t B, int64_t sibling_sub, int64_t hist_acc, float reg_lambda,
+    float reg_alpha, float max_delta_step, float min_child_weight,
     ffi::Result<ffi::Buffer<ffi::S32>> pos_out,
     ffi::Result<ffi::Buffer<ffi::PRED>> is_split,
     ffi::Result<ffi::Buffer<ffi::S32>> feature,
@@ -434,22 +759,27 @@ ffi::Error TreeGrowImpl(
                 (size_t)max_nodes * sizeof(float));
     const SplitP p{reg_lambda, reg_alpha, max_delta_step, min_child_weight};
     const float g0 = G0.typed_data()[0], h0 = H0.typed_data()[0];
+    const bool quant = hist_acc != 0;
     if (bins.element_type() == ffi::U8) {
-        tree_grow_loop(reinterpret_cast<const uint8_t*>(bins.untyped_data()),
-                       gh.typed_data(), cut_values.typed_data(),
-                       tree_mask.typed_data(), g0, h0, n, F, B, max_depth,
-                       sibling_sub != 0, p, pos, isl, feature->typed_data(),
-                       split_bin->typed_data(), split_cond->typed_data(),
-                       dfl, node_g->typed_data(), node_h->typed_data(),
-                       node_w->typed_data(), loss_chg->typed_data());
+        const auto* b8 =
+            reinterpret_cast<const uint8_t*>(bins.untyped_data());
+        (quant ? tree_grow_loop_quant<uint8_t> : tree_grow_loop<uint8_t>)(
+            b8, gh.typed_data(), cut_values.typed_data(),
+            tree_mask.typed_data(), g0, h0, n, F, B, max_depth,
+            sibling_sub != 0, p, pos, isl, feature->typed_data(),
+            split_bin->typed_data(), split_cond->typed_data(), dfl,
+            node_g->typed_data(), node_h->typed_data(),
+            node_w->typed_data(), loss_chg->typed_data());
     } else if (bins.element_type() == ffi::U16) {
-        tree_grow_loop(reinterpret_cast<const uint16_t*>(bins.untyped_data()),
-                       gh.typed_data(), cut_values.typed_data(),
-                       tree_mask.typed_data(), g0, h0, n, F, B, max_depth,
-                       sibling_sub != 0, p, pos, isl, feature->typed_data(),
-                       split_bin->typed_data(), split_cond->typed_data(),
-                       dfl, node_g->typed_data(), node_h->typed_data(),
-                       node_w->typed_data(), loss_chg->typed_data());
+        const auto* b16 =
+            reinterpret_cast<const uint16_t*>(bins.untyped_data());
+        (quant ? tree_grow_loop_quant<uint16_t> : tree_grow_loop<uint16_t>)(
+            b16, gh.typed_data(), cut_values.typed_data(),
+            tree_mask.typed_data(), g0, h0, n, F, B, max_depth,
+            sibling_sub != 0, p, pos, isl, feature->typed_data(),
+            split_bin->typed_data(), split_cond->typed_data(), dfl,
+            node_g->typed_data(), node_h->typed_data(),
+            node_w->typed_data(), loss_chg->typed_data());
     } else {
         return ffi::Error(ffi::ErrorCode::kInvalidArgument,
                           "bins must be uint8 or uint16");
@@ -523,6 +853,115 @@ ffi::Error HbLevelSubImpl(ffi::AnyBuffer bins, ffi::Buffer<ffi::S32> pos,
     return ffi::Error::Success();
 }
 
+// ---- per-level quantized kernel (kernelprof mirror, quant route) -------
+//
+// One level of the quant engine: quantiser recomputed from the FULL gh
+// (deterministic — identical to the whole-tree kernel's once-per-round
+// computation), partition, row lists, integer accumulate, and (with
+// sibling_sub) integer derive from the previous level's int64 histogram.
+// The int64 histogram crosses the FFI boundary as packed little-endian
+// int32 word pairs ([F, 2K, B, 2] s32) because the mirror runs with
+// jax x64 disabled — an f32 carry would drop bits once a cell's sum
+// exceeds 24 mantissa bits and break the sampled-round bit-identity
+// contract. hist_f is the dequantized f32 view `_level_update_jit`
+// consumes. At the root (Kp == 0) partition and derive are skipped and
+// every slot builds directly.
+
+template <typename BinT>
+void level_quant_impl(const BinT* bins, int32_t* pos, const float* gh,
+                      const float* ptab, const int64_t* prev_q, int64_t n,
+                      int64_t F, int64_t B, int64_t K, int64_t Kp,
+                      int64_t poff, int64_t off, bool sub, int64_t* hq,
+                      float* hist_f) {
+    const QScale qs = compute_qscale(gh, n);
+    std::vector<int64_t> qrow((size_t)n);
+    quantize_rows(gh, n, qs, qrow.data());
+    if (Kp >= 1) {
+        std::vector<uint8_t> isplit((size_t)Kp), dleft((size_t)Kp);
+        std::vector<int32_t> feat((size_t)Kp), bin((size_t)Kp);
+        for (int64_t j = 0; j < Kp; ++j) {
+            const float* dec = ptab + j * 4;
+            isplit[j] = dec[0] > 0.5f ? 1 : 0;
+            feat[j] = (int32_t)dec[1];
+            bin[j] = (int32_t)dec[2];
+            dleft[j] = dec[3] > 0.5f ? 1 : 0;
+        }
+        partition_rows(bins, pos, isplit.data(), feat.data(), bin.data(),
+                       dleft.data(), n, F, B, Kp, poff);
+    }
+    std::vector<int64_t> counts((size_t)K);
+    count_rows(pos, n, off, K, counts.data());
+    std::vector<uint8_t> bmask((size_t)K);
+    const uint8_t* mask = nullptr;
+    if (sub && Kp >= 1) {
+        plan_siblings(counts.data(), Kp, bmask.data());
+        mask = bmask.data();
+    }
+    std::vector<int64_t> rl_start((size_t)(K + 1));
+    std::vector<int32_t> rows((size_t)n);
+    std::vector<int64_t> scratch;
+    build_row_lists(counts.data(), mask, pos, n, off, K, rl_start.data(),
+                    rows.data());
+    accumulate_level_quant(bins, qrow.data(), rows.data(), rl_start.data(),
+                           F, B, K, mask, hq, scratch);
+    if (mask) derive_siblings_quant(prev_q, hq, F, B, K, Kp, counts.data());
+    dequantize_level(hq, qs, F, B, K, hist_f);
+}
+
+ffi::Error HbLevelQuantImpl(ffi::AnyBuffer bins, ffi::Buffer<ffi::S32> pos,
+                            ffi::Buffer<ffi::F32> gh,
+                            ffi::Buffer<ffi::F32> ptab,
+                            ffi::Buffer<ffi::S32> prev_hist_q,
+                            ffi::Buffer<ffi::S32> prev_offset,
+                            ffi::Buffer<ffi::S32> offset, int64_t K,
+                            int64_t Kp, int64_t B, int64_t sibling_sub,
+                            ffi::Result<ffi::Buffer<ffi::S32>> pos_out,
+                            ffi::Result<ffi::Buffer<ffi::S32>> hist_q,
+                            ffi::Result<ffi::Buffer<ffi::F32>> hist_f) {
+    const auto dims = bins.dimensions();
+    if (dims.size() != 2) {
+        return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                          "bins must be [n, F]");
+    }
+    if (!(K == 2 * Kp || (K == 1 && Kp == 0))) {
+        return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                          "quant level needs K == 2 * Kp (or K == 1 at "
+                          "the root)");
+    }
+    const int64_t n = dims[0], F = dims[1];
+    if ((int64_t)prev_hist_q.element_count() != F * 2 * Kp * B * 2) {
+        return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                          "prev_hist_q must be [F, 2Kp, B, 2] int32 "
+                          "word pairs");
+    }
+    const int64_t poff = prev_offset.typed_data()[0];
+    const int64_t off = offset.typed_data()[0];
+    int32_t* po_out = pos_out->typed_data();
+    std::memcpy(po_out, pos.typed_data(), (size_t)n * sizeof(int32_t));
+    // the int64 histograms live in the s32 result buffer: same bytes,
+    // [F, 2K, B, 2] little-endian word pairs on the wire
+    auto* hq = static_cast<int64_t*>(hist_q->untyped_data());
+    const auto* pq = static_cast<const int64_t*>(prev_hist_q.untyped_data());
+    std::memset(hq, 0, (size_t)(F * 2 * K * B) * sizeof(int64_t));
+    float* hf = hist_f->typed_data();
+    std::memset(hf, 0, (size_t)(F * 2 * K * B) * sizeof(float));
+    if (bins.element_type() == ffi::U8) {
+        level_quant_impl(reinterpret_cast<const uint8_t*>(
+                             bins.untyped_data()),
+                         po_out, gh.typed_data(), ptab.typed_data(), pq, n,
+                         F, B, K, Kp, poff, off, sibling_sub != 0, hq, hf);
+    } else if (bins.element_type() == ffi::U16) {
+        level_quant_impl(reinterpret_cast<const uint16_t*>(
+                             bins.untyped_data()),
+                         po_out, gh.typed_data(), ptab.typed_data(), pq, n,
+                         F, B, K, Kp, poff, off, sibling_sub != 0, hq, hf);
+    } else {
+        return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                          "bins must be uint8 or uint16");
+    }
+    return ffi::Error::Success();
+}
+
 }  // namespace
 
 XLA_FFI_DEFINE_HANDLER_SYMBOL(
@@ -537,6 +976,7 @@ XLA_FFI_DEFINE_HANDLER_SYMBOL(
         .Attr<int64_t>("max_depth")
         .Attr<int64_t>("B")
         .Attr<int64_t>("sibling_sub")
+        .Attr<int64_t>("hist_acc")
         .Attr<float>("reg_lambda")
         .Attr<float>("reg_alpha")
         .Attr<float>("max_delta_step")
@@ -567,3 +1007,21 @@ XLA_FFI_DEFINE_HANDLER_SYMBOL(
         .Attr<int64_t>("B")
         .Ret<ffi::Buffer<ffi::S32>>()    // pos_out [n, 1]
         .Ret<ffi::Buffer<ffi::F32>>());  // hist [F, 2K, B]
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    XgbtpuHbLevelQuant, HbLevelQuantImpl,
+    ffi::Ffi::Bind()
+        .Arg<ffi::AnyBuffer>()           // bins [n, F] u8/u16
+        .Arg<ffi::Buffer<ffi::S32>>()    // pos [n, 1] (previous level)
+        .Arg<ffi::Buffer<ffi::F32>>()    // gh [n, 2]
+        .Arg<ffi::Buffer<ffi::F32>>()    // ptab [max(Kp, 1), 4]
+        .Arg<ffi::Buffer<ffi::S32>>()    // prev_hist_q [F, 2Kp, B, 2]
+        .Arg<ffi::Buffer<ffi::S32>>()    // prev_offset (0-d)
+        .Arg<ffi::Buffer<ffi::S32>>()    // offset (0-d)
+        .Attr<int64_t>("K")
+        .Attr<int64_t>("Kp")
+        .Attr<int64_t>("B")
+        .Attr<int64_t>("sibling_sub")
+        .Ret<ffi::Buffer<ffi::S32>>()    // pos_out [n, 1]
+        .Ret<ffi::Buffer<ffi::S32>>()    // hist_q [F, 2K, B, 2]
+        .Ret<ffi::Buffer<ffi::F32>>());  // hist_f [F, 2K, B]

@@ -159,7 +159,8 @@ class _Profile:
     """Accumulator for ONE sampled round (all trees of the round)."""
 
     __slots__ = ("round_idx", "buckets", "host_syncs", "trees", "depth",
-                 "route", "sibling_sub", "_last_done_ns")
+                 "route", "sibling_sub", "hist_acc", "quant_scales",
+                 "_last_done_ns")
 
     def __init__(self, round_idx: int) -> None:
         self.round_idx = int(round_idx)
@@ -172,6 +173,11 @@ class _Profile:
         # would run as ONE native dispatch; "level" = per-level program)
         self.route = "level"
         self.sibling_sub = False
+        # resolved hist_acc impl on the tree_grow route ("quant" /
+        # "float"); quant_scales carries the round's quantiser grid
+        # exponents {"g_exp": Eg, "h_exp": Eh} (dequantize = * 2^-E)
+        self.hist_acc = "float"
+        self.quant_scales: Optional[Dict[str, int]] = None
         self._last_done_ns = 0
 
     def record(self, op: str, depth: int, impl: str,
@@ -203,6 +209,8 @@ class _Profile:
             "driver": DRIVER,
             "route": self.route,
             "sibling_sub": self.sibling_sub,
+            "hist_acc": self.hist_acc,
+            "quant_scales": self.quant_scales,
             "trees": self.trees,
             "host_syncs": self.host_syncs,
             "sum_s": round(sum(b["wall_s"] for b in ops), 6),
@@ -316,6 +324,27 @@ def _prep_fn() -> Callable:
         return _PREP_JIT
 
 
+#: fixed-point quantiser width — MUST match kQBits in native/tree_build.cpp
+_KQBITS = 18
+
+
+def _quant_scales(gh) -> Dict[str, int]:
+    """The sampled round's quantiser grid exponents, mirroring
+    tree_build.cpp's ``compute_qscale``: per-lane max of finite |x|,
+    ``E = kQBits − frexp-exponent`` (quantize = ``llrint(x * 2^E)``,
+    dequantize = ``* 2^−E``). Recorded in the grow_detail record so a
+    reader can see the grid the integer engine ran on."""
+    import numpy as np
+
+    a = np.abs(np.asarray(gh, dtype=np.float64))
+    a = np.where(np.isfinite(a), a, 0.0)
+    out: Dict[str, int] = {}
+    for idx, name in ((0, "g_exp"), (1, "h_exp")):
+        m = float(a[:, idx].max()) if a.size else 0.0
+        out[name] = int(_KQBITS - np.frexp(m)[1]) if m > 0.0 else 0
+    return out
+
+
 def grow_tree_fused_profiled(bins, grad, hess, cut_values, key, eta, gamma,
                              cfg, feature_weights=None, onehot=None):
     """Instrumented mirror of ``grow_tree_fused`` for a sampled round:
@@ -351,11 +380,16 @@ def grow_tree_fused_profiled(bins, grad, hess, cut_values, key, eta, gamma,
                                    str(bins.dtype))
              else "level")
     sub_on = False
+    quant_on = False
     if route == "tree_grow":
-        sub_on = dispatch.resolve("sibling_sub", dispatch.Ctx(
-            platform=jax.default_backend())).impl == "on"
+        plat = jax.default_backend()
+        sub_on = dispatch.resolve(
+            "sibling_sub", dispatch.Ctx(platform=plat)).impl == "on"
+        quant_on = dispatch.resolve(
+            "hist_acc", dispatch.Ctx(platform=plat)).impl == "quant"
     prof.route = route
     prof.sibling_sub = sub_on
+    prof.hist_acc = "quant" if quant_on else "float"
     if pallas:
         bins = bins.astype(jnp.int32)
     n, F = bins.shape
@@ -375,10 +409,26 @@ def grow_tree_fused_profiled(bins, grad, hess, cut_values, key, eta, gamma,
                 cfg=cfg, F=int(F), B=int(B))
             pos = jnp.zeros((n, 1), jnp.int32)
             prev_hist = None
+            # the quant route carries the previous level's int64
+            # histogram as packed int32 word pairs — empty at the root
+            prev_q = jnp.zeros((F, 0, B, 2), jnp.int32)
+            if quant_on:
+                prof.quant_scales = _quant_scales(gh)
             for d in range(max_depth):
                 prof.depth = d
                 K = 1 << d
-                if route == "tree_grow" and sub_on and d >= 1:
+                if route == "tree_grow" and quant_on:
+                    # quant engine for EVERY level (root included): the
+                    # sampled round's histograms must match the fused
+                    # kernel's integer accumulation bit-for-bit, and the
+                    # int64 carry never passes through f32
+                    from ..tree import tree_kernel as _tk
+
+                    pos, prev_q, histC = dispatch.invoke(
+                        "level_hist", _tk.fused_level_quant_native, bins,
+                        pos, gh, st.ptab, prev_q, K=K, Kp=K >> 1, B=B,
+                        d=d, sibling_sub=sub_on)
+                elif route == "tree_grow" and sub_on and d >= 1:
                     from ..tree import tree_kernel as _tk
 
                     pos, histC = dispatch.invoke(
@@ -433,9 +483,20 @@ def format_grow_detail(rec: Dict[str, Any],
     if route:
         route_note = f", route={route}"
         if route == "tree_grow":
-            # per-level replay of a one-dispatch production round
-            route_note += (" (sibling-sub replay)" if rec.get("sibling_sub")
-                           else " (per-level replay)")
+            # per-level replay of a one-dispatch production round; the
+            # resolved hist_acc impl picks the replay flavour, and the
+            # quant flavour shows the round's quantiser grid
+            if rec.get("hist_acc") == "quant":
+                route_note += " (quant replay"
+                qs = rec.get("quant_scales") or {}
+                if qs:
+                    route_note += (f", scales g=2^-{qs.get('g_exp')}"
+                                   f" h=2^-{qs.get('h_exp')}")
+                route_note += ")"
+            elif rec.get("sibling_sub"):
+                route_note += " (sibling-sub replay)"
+            else:
+                route_note += " (per-level replay)"
     lines = [
         f"round {rec.get('round')}: grow detail "
         f"({rec.get('driver')}, {rec.get('trees')} tree(s){route_note})",
@@ -502,6 +563,7 @@ def format_grow_diff(agg_a: Dict[Tuple[int, str], Dict[str, Any]],
         return "-" if v is None else f"{v * 1e3:.3f}ms"
 
     tot_a = tot_b = 0.0
+    changed = 0
     for depth, op in sorted(set(agg_a) | set(agg_b)):
         a = agg_a.get((depth, op))
         b = agg_b.get((depth, op))
@@ -513,11 +575,21 @@ def format_grow_diff(agg_a: Dict[Tuple[int, str], Dict[str, Any]],
         ib = b["impl"] if b else "-"
         impl = ia if ia == ib else f"{ia}->{ib}"
         delta = "-" if (wa is None or wb is None) else ms(wb - wa)
+        # rows whose resolved impl changed between the runs get a
+        # visible marker — a reader scanning a long table should not
+        # have to eyeball the impl column to spot a route flip
+        mark = ""
+        if ia != ib and a is not None and b is not None:
+            mark = " *"
+            changed += 1
         lines.append(
             f"  {('prep' if depth < 0 else depth)!s:>5} {op:<16} "
-            f"{impl:<16} {ms(wa):>10} {ms(wb):>10} {delta:>10}")
+            f"{impl:<16} {ms(wa):>10} {ms(wb):>10} {delta:>10}{mark}")
     lines.append(f"  substages A {ms(tot_a)}, B {ms(tot_b)}, "
                  f"delta {ms(tot_b - tot_a)}")
+    if changed:
+        lines.append(f"  * = resolved impl changed between runs "
+                     f"({changed} row(s))")
     return "\n".join(lines)
 
 

@@ -419,18 +419,22 @@ def _grow_tree_fused_impl(
         # eval and heap update, plus the final leaf routing — runs as ONE
         # native custom call per round instead of ~2 dispatches per level.
         # The kernel's outputs satisfy _level_update's state contract
-        # bit-for-bit (subtraction off), so _finalize consumes them
-        # unchanged. Sibling subtraction resolves through its own table
-        # row (XGBTPU_SIBLING_SUB=0 -> sibling_sub=off pin).
+        # bit-for-bit (subtraction off + hist_acc float), so _finalize
+        # consumes them unchanged. Sibling subtraction and the histogram
+        # accumulation core resolve through their own table rows
+        # (XGBTPU_SIBLING_SUB=0 -> sibling_sub=off pin; hist_acc=quant
+        # is the fixed-point integer engine, hist_acc=float the r17
+        # core).
         from ..dispatch import Ctx, resolve
         from .tree_kernel import tree_grow_native
 
-        sub_on = resolve("sibling_sub", Ctx(
-            platform=jax.default_backend())).impl == "on"
+        plat = jax.default_backend()
+        sub_on = resolve("sibling_sub", Ctx(platform=plat)).impl == "on"
+        hist_acc = resolve("hist_acc", Ctx(platform=plat)).impl
         (pos, isl, feat, sbin, scond, dleft, ng, nh, nw, lchg) = \
             tree_grow_native(bins, gh, cut_values, tree_mask, G0, H0,
                              max_depth=max_depth, B=B, sibling_sub=sub_on,
-                             split=p)
+                             hist_acc=hist_acc, split=p)
         st = st._replace(is_split=isl, feature=feat, split_bin=sbin,
                          split_cond=scond, default_left=dleft, node_g=ng,
                          node_h=nh, node_w=nw, loss_chg=lchg)

@@ -7,19 +7,26 @@ finalized heap arrays ``_finalize_jit`` consumes plus the leaf-level row
 positions. The in-core CPU round drops from ~2 dispatches per level
 (``fused_level`` + ``_level_update_jit``) to ONE host round-trip per round.
 
-Two FFI entries are registered together (they share the C++ core loops, so
-their histograms are bit-identical by construction):
+Three FFI entries are registered together (they share the C++ core loops,
+so their histograms are bit-identical by construction):
 
 * ``xgbtpu_tree_grow`` — the whole-tree kernel (``tree_grow_native``).
 * ``xgbtpu_hb_level_sub`` — ONE level of the same partition + sibling-
   subtraction machinery (``fused_level_sub_native``), used by the
   kernelprof mirror so sampled rounds can replay the round per-level for
   attribution while staying bit-identical to the fused kernel's output.
+* ``xgbtpu_hb_level_quant`` — ONE level of the quantized-gradient engine
+  (``fused_level_quant_native``, ISSUE 19): the mirror's level step when
+  the round ran with ``hist_acc=quant``, carrying the previous level's
+  int64 histogram across calls as packed int32 word pairs (x64 stays
+  off; an f32 carry would drop bits past 24-bit sums).
 
-Route selection lives in the dispatch registry (``dispatch/ops.py``, op
-``tree_grow``); the ``XGBTPU_SIBLING_SUB=0`` kill switch maps to a
-``sibling_sub=off`` pin there and makes the kernel bit-identical to the
-per-level native path (see tree_build.cpp's contract comment).
+Route selection lives in the dispatch registry (``dispatch/ops.py``, ops
+``tree_grow`` / ``sibling_sub`` / ``hist_acc``); the
+``XGBTPU_SIBLING_SUB=0`` kill switch maps to a ``sibling_sub=off`` pin
+there, and pinning BOTH ``sibling_sub=off`` and ``hist_acc=float`` makes
+the kernel bit-identical to the per-level native path (see
+tree_build.cpp's contract comment).
 """
 
 from __future__ import annotations
@@ -31,7 +38,8 @@ import jax.numpy as jnp
 import numpy as np
 
 __all__ = [
-    "tree_grow_native", "fused_level_sub_native", "tree_ffi_ready",
+    "tree_grow_native", "fused_level_sub_native",
+    "fused_level_quant_native", "tree_ffi_ready",
 ]
 
 _ffi_lock = threading.Lock()
@@ -60,6 +68,9 @@ def tree_ffi_ready() -> bool:
             jffi.register_ffi_target(
                 "xgbtpu_hb_level_sub", jffi.pycapsule(lib.XgbtpuHbLevelSub),
                 platform="cpu")
+            jffi.register_ffi_target(
+                "xgbtpu_hb_level_quant",
+                jffi.pycapsule(lib.XgbtpuHbLevelQuant), platform="cpu")
             _ffi_state["registered"] = True
         except Exception:
             return False
@@ -67,16 +78,22 @@ def tree_ffi_ready() -> bool:
 
 
 def tree_grow_native(bins, gh, cut_values, tree_mask, G0, H0, *,
-                     max_depth: int, B: int, sibling_sub: bool, split):
+                     max_depth: int, B: int, sibling_sub: bool,
+                     hist_acc: str, split):
     """One boosting round's depth loop as a single custom call.
 
     Returns ``(pos, is_split, feature, split_bin, split_cond, default_left,
     node_g, node_h, node_w, loss_chg)`` — ``pos`` [n, 1] i32 already routed
     into the LEAF level (the driver's final ``partition_apply`` is folded
     in), the rest heap arrays of ``max_nodes = 2^(max_depth+1) - 1``
-    matching ``_level_update``'s state contract bit-for-bit (sub off).
-    Scalar split params travel as f32 attributes — the same f64 -> f32
-    rounding XLA applies to Python float constants at trace time."""
+    matching ``_level_update``'s state contract bit-for-bit (sub off +
+    hist_acc float). ``hist_acc`` selects the histogram core:
+    ``"quant"`` runs the fixed-point integer engine (per-node row lists,
+    packed int32 lanes, int64 merge — thread-count invariant by
+    construction), ``"float"`` the r17 f32 core (the bit-identity kill
+    switch). Scalar split params travel as f32 attributes — the same
+    f64 -> f32 rounding XLA applies to Python float constants at trace
+    time."""
     from jax.extend import ffi as jffi
 
     n, F = bins.shape
@@ -98,6 +115,7 @@ def tree_grow_native(bins, gh, cut_values, tree_mask, G0, H0, *,
         G0.astype(jnp.float32), H0.astype(jnp.float32),
         max_depth=int(max_depth), B=int(B),
         sibling_sub=int(bool(sibling_sub)),
+        hist_acc=int(hist_acc == "quant"),
         reg_lambda=np.float32(split.reg_lambda),
         reg_alpha=np.float32(split.reg_alpha),
         max_delta_step=np.float32(split.max_delta_step),
@@ -124,3 +142,31 @@ def fused_level_sub_native(bins, pos, gh, ptab, prev_hist, *, K: int,
          jax.ShapeDtypeStruct((F, 2 * K, B), jnp.float32)),
         bins, pos, gh, ptab, prev_hist, prev_offset, offset,
         K=K, Kp=Kp, B=B)
+
+
+def fused_level_quant_native(bins, pos, gh, ptab, prev_hist_q, *, K: int,
+                             Kp: int, B: int, d: int, sibling_sub: bool):
+    """ONE level of the quantized-gradient histogram engine (hist_acc =
+    quant), for the kernelprof mirror: quantiser recomputed from the full
+    ``gh`` (identical to the whole-tree kernel's per-round computation),
+    partition, per-node row lists, packed-integer accumulation and (with
+    ``sibling_sub``) EXACT integer sibling derivation from
+    ``prev_hist_q``. Returns ``(new pos [n,1] i32, hist_q [F, 2K, B, 2]
+    i32, hist_f [F, 2K, B] f32)`` — ``hist_q`` is the level's int64
+    histogram as packed little-endian int32 word pairs (carried between
+    levels so no f32 rounding ever touches the running sums; jax x64
+    stays off), ``hist_f`` the dequantized view ``_level_update_jit``
+    consumes. At the root pass ``Kp=0`` with an empty ``prev_hist_q``
+    ([F, 0, B, 2]); partition and derive are skipped there."""
+    from jax.extend import ffi as jffi
+
+    n, F = bins.shape
+    prev_offset = jnp.int32((1 << max(d - 1, 0)) - 1)
+    offset = jnp.int32((1 << d) - 1)
+    return jffi.ffi_call(
+        "xgbtpu_hb_level_quant",
+        (jax.ShapeDtypeStruct((n, 1), jnp.int32),
+         jax.ShapeDtypeStruct((F, 2 * K, B, 2), jnp.int32),
+         jax.ShapeDtypeStruct((F, 2 * K, B), jnp.float32)),
+        bins, pos, gh, ptab, prev_hist_q, prev_offset, offset,
+        K=K, Kp=Kp, B=B, sibling_sub=int(bool(sibling_sub)))
