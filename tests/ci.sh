@@ -70,6 +70,28 @@ echo "$REPORT_OUT" | grep -E -q "tree_grow\s+->\s+native" || {
 echo "$REPORT_OUT" | grep -E -q "hist_acc\s+->\s+quant" || {
   echo "hist_acc does not resolve to the quantized core on CPU"
   exit 1; }
+# the native routes above only exist because every .so passed its
+# load-time canary (ISSUE 20): assert the verdict gauges actually read
+# HEALTHY (1) — a canary refusal would silently flip the routes to XLA
+# and the grep above would catch tree_grow but not the other libraries
+python - <<'EOF'
+from xgboost_tpu import native
+from xgboost_tpu.observability import REGISTRY
+
+loaded = [lib for lib, get in (
+    ("tree_build", native.get_tree_lib),
+    ("hist_build", native.get_hist_lib),
+    ("sketch_bin", native.get_sketch_lib),
+    ("serving_walk", native.get_serving_lib),
+) if get() is not None]
+assert loaded, "no native library loaded on the CI runner"
+gauge = REGISTRY.get("native_canary_state")
+assert gauge is not None, "canary gauge never published"
+for lib in loaded:
+    state = gauge.labels(lib=lib).value
+    assert state == 1, f"native_canary_state{{lib={lib!r}}} = {state} != 1"
+print(f"canary OK: {len(loaded)} native libraries proven healthy")
+EOF
 
 echo "=== tier 0.75: perf regression gate (envelope + seeded self-test) ==="
 # A fixed-shape smoke bench vs the checked-in envelope with an explicit
@@ -189,6 +211,52 @@ assert 'degrade_state{capability="pallas_predict"}' in exp
 assert 'degrade_state{capability="onehot_build"}' in exp
 print(f"chaos smoke OK: {len(plan.fired)} injected faults absorbed, "
       "fault history in exposition")
+EOF
+
+# Native-boundary containment drill (ISSUE 20): a seeded crash at the
+# native dispatch of round 2 — the SIGSEGV-equivalent — must degrade the
+# library, re-route the round onto the XLA fallback, and let the
+# checkpointed run complete AND resume. The process surviving this lane
+# at all is the acceptance criterion; the exposition asserts make the
+# fault history auditable.
+XGBTPU_CHAOS="native_dispatch:crash:2" python - <<'EOF'
+import tempfile
+
+import numpy as np
+
+import xgboost_tpu as xgb
+from xgboost_tpu import dispatch
+from xgboost_tpu.observability import REGISTRY
+from xgboost_tpu.resilience import HEALTHY, chaos, degrade
+
+plan = chaos.active_plan()
+assert plan is not None, "native_dispatch chaos env not armed"
+
+rng = np.random.RandomState(0)
+X = rng.randn(2000, 6).astype(np.float32)
+y = (X[:, 0] > 0).astype(np.float32)
+ck = tempfile.mkdtemp()
+params = {"objective": "binary:logistic", "max_depth": 3,
+          "max_bin": 16, "verbosity": 0}
+bst = xgb.train(params, xgb.DMatrix(X, label=y), 4, verbose_eval=False,
+                resume_from=ck, checkpoint_interval=1)
+assert bst.num_boosted_rounds() == 4, "containment lost rounds"
+assert np.isfinite(bst.predict(xgb.DMatrix(X))).all()
+assert plan.fired == [("native_dispatch", 2, "crash")], plan.fired
+assert degrade.worst("native_tree") != HEALTHY, \
+    "crash at the native boundary did not degrade native_tree"
+assert dispatch.last_decisions().get("tree_grow") == "level", \
+    "degraded native_tree did not re-route tree_grow to the XLA path"
+exp = REGISTRY.exposition()
+assert 'native_faults_total{kind="crash",lib="tree_build"}' in exp, exp
+assert 'degrade_state{capability="native_tree"}' in exp
+# the survivor's checkpoints stay resumable past the degraded window
+chaos.reset()
+bst = xgb.train(params, xgb.DMatrix(X, label=y), 6, verbose_eval=False,
+                resume_from=ck, checkpoint_interval=1)
+assert bst.num_boosted_rounds() == 6, "resume after containment failed"
+print("native containment OK: crash absorbed, degraded to XLA, "
+      "4+2 rounds committed")
 EOF
 
 # Pipelined-round fault surfacing (ISSUE 13 satellite): a seeded fault

@@ -210,20 +210,25 @@ def test_chaos_schedule_determinism_across_processes(tmp_path):
     two separate interpreters arm the same ``%K`` and ``pP@seed``
     schedules and record which of 60 hits fire — the traces must match
     exactly, and the probabilistic one must be seed-deterministic, not
-    RNG-state-dependent."""
+    RNG-state-dependent. The ISSUE 20 native-boundary sites ride the same
+    contract with their crash/timeout/corrupt modes: the mode must arrive
+    on the error (``chaos_mode``) at exactly the same hits too, or the
+    canary/dispatch drills would diverge between trainer processes."""
     prog = r"""
 import json, sys
 from xgboost_tpu.resilience import chaos
 from xgboost_tpu.resilience.chaos import ChaosError
 fired = {}
-with chaos.configure("tick:transient:%7;tock:transient:p0.3@42") as plan:
-    for site in ("tick", "tock"):
+sched = ("tick:transient:%7;tock:transient:p0.3@42;"
+         "native_canary:crash:%11;native_dispatch:corrupt:p0.25@7")
+with chaos.configure(sched) as plan:
+    for site in ("tick", "tock", "native_canary", "native_dispatch"):
         hits = []
         for n in range(1, 61):
             try:
                 chaos.hit(site)
-            except ChaosError:
-                hits.append(n)
+            except ChaosError as e:
+                hits.append([n, getattr(e, "chaos_mode", "")])
         fired[site] = hits
 print(json.dumps(fired))
 """
@@ -240,9 +245,15 @@ print(json.dumps(fired))
         results.append(json.loads(out.stdout))
     assert results[0] == results[1], \
         "seeded chaos schedules diverged across processes"
-    assert results[0]["tick"] == [7, 14, 21, 28, 35, 42, 49, 56]
+    assert results[0]["tick"] == [[n, ""] for n in
+                                  (7, 14, 21, 28, 35, 42, 49, 56)]
     assert results[0]["tock"], "p0.3@42 fired nowhere in 60 hits"
     assert len(results[0]["tock"]) < 60
+    assert results[0]["native_canary"] == [[n, "crash"] for n in
+                                           (11, 22, 33, 44, 55)]
+    nd = results[0]["native_dispatch"]
+    assert nd and len(nd) < 60, "p0.25@7 corrupt fired never/always"
+    assert {mode for _, mode in nd} == {"corrupt"}
 
 
 def test_membership_detection_and_heartbeat_drop(tmp_path, monkeypatch):

@@ -46,8 +46,11 @@ def test_package_lints_clean_against_baseline():
     # FEED the dispatch ctx — every actual impl choice now resolves
     # through dispatch/, and two pre-dispatch entries were pruned;
     # re-tightened to 48 with the cross-boundary families: NB6xx/OMP7xx/
-    # DR8xx all run clean on the fixed package, zero new suppressions)
-    assert len(suppressed) < 48
+    # DR8xx all run clean on the fixed package, zero new suppressions;
+    # 48 -> 53 with RH202: the native-boundary contract/degrade reads
+    # (boundary.py, ffi_contract.py, degrade.py) are host-side
+    # trace-time state — same contract as the config._state entry)
+    assert len(suppressed) < 53
 
 
 def test_baseline_entries_all_justified():

@@ -201,10 +201,10 @@ def _ensure_sketch_ffi() -> bool:
 def _native_cuts_prog(n: int, F: int, B: int):
     """Jitted wrapper around the XgbtpuSketchCuts custom call for one
     shape (the jit guarantees executable caching for eager invocation)."""
-    from jax.extend import ffi as jffi
+    from ..native import boundary
 
     def run(X, w):
-        return jffi.ffi_call(
+        return boundary.ffi_call(
             "xgbtpu_sketch_cuts",
             (jax.ShapeDtypeStruct((F, B), jnp.float32),
              jax.ShapeDtypeStruct((F,), jnp.float32)),
@@ -215,13 +215,13 @@ def _native_cuts_prog(n: int, F: int, B: int):
 
 @lru_cache(maxsize=64)
 def _native_bins_prog(n: int, F: int, B: int, dtype_name: str):
-    from jax.extend import ffi as jffi
+    from ..native import boundary
 
     target = ("xgbtpu_bin_matrix_u8" if dtype_name == "uint8"
               else "xgbtpu_bin_matrix_u16")
 
     def run(X, cut_values):
-        return jffi.ffi_call(
+        return boundary.ffi_call(
             target, jax.ShapeDtypeStruct((n, F), jnp.dtype(dtype_name)),
             X, cut_values)
 

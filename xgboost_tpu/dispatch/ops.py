@@ -13,20 +13,20 @@ Op reference (see docs/perf.md, "Choosing a kernel"):
 ====================  =========================================  =============
 op                    implementations (preference order)         capability
 ====================  =========================================  =============
-``tree_grow``         native (CPU, whole-round kernel) > level   —
+``tree_grow``         native (CPU, whole-round kernel) > level   native_tree
 ``sibling_sub``       on > off (histogram subtraction trick)     —
 ``hist_acc``          CPU: quant > float (integer histogram      —
                       accumulation inside the whole-tree kernel)
-``level_hist``        pallas > native (CPU) > xla                —
-``level_partition``   native (CPU) > xla                         —
+``level_hist``        pallas > native (CPU) > xla                native_hist
+``level_partition``   native (CPU) > xla                         native_hist
 ``level_update``      xla (single impl: shared split eval)       —
 ``depth_scan``        scanned > unrolled                         —
 ``onehot_build``      pallas > xla                               —
 ``leaf_delta``        pallas > xla                               —
 ``predict_walk``      TPU: pallas > xla > native;                pallas_predict
-                      CPU: native > xla                          (device impls)
-``sketch_cuts``       CPU: native > xla; TPU: xla                —
-``bin_matrix``        CPU: native > xla; TPU: xla                —
+                      CPU: native > xla                          / native_serving
+``sketch_cuts``       CPU: native > xla; TPU: xla                native_sketch
+``bin_matrix``        CPU: native > xla; TPU: xla                native_sketch
 ====================  =========================================  =============
 """
 
@@ -95,7 +95,8 @@ def _tree_grow_native_available(ctx: Ctx) -> bool:
 # every out-of-envelope config keeps.
 register("tree_grow", "native", pref=(("cpu", 0), ("*", 2)),
          applicable=_tree_grow_native_applicable,
-         available=_tree_grow_native_available)
+         available=_tree_grow_native_available,
+         capability="native_tree")
 register("tree_grow", "level", pref=(("*", 1),))
 set_report_ctx("tree_grow", lambda: Ctx(
     platform=_platform(), pallas=_platform() == "tpu", interpret=False,
@@ -137,7 +138,8 @@ register("level_hist", "pallas", pref=(("*", 0),),
          applicable=_pallas_level_applicable)
 register("level_hist", "native", pref=(("*", 1),),
          applicable=_native_level_applicable,
-         available=_native_level_available)
+         available=_native_level_available,
+         capability="native_hist")
 register("level_hist", "xla", pref=(("*", 2),))
 set_report_ctx("level_hist", lambda: Ctx(
     platform=_platform(), pallas=_platform() == "tpu", interpret=False,
@@ -147,7 +149,8 @@ set_report_ctx("level_hist", lambda: Ctx(
 
 register("level_partition", "native", pref=(("*", 0),),
          applicable=_native_level_applicable,
-         available=_native_level_available)
+         available=_native_level_available,
+         capability="native_hist")
 register("level_partition", "xla", pref=(("*", 1),))
 set_report_ctx("level_partition", lambda: Ctx(
     platform=_platform(), interpret=False, table_width=4,
@@ -235,7 +238,8 @@ register("predict_walk", "xla", pref=(("*", 1),),
          capability="pallas_predict", cap_platforms=("tpu",))
 register("predict_walk", "native", pref=(("cpu", 0), ("*", 2)),
          applicable=_walk_native_applicable,
-         available=_walk_native_available)
+         available=_walk_native_available,
+         capability="native_serving")
 set_report_ctx("predict_walk", lambda: Ctx(
     platform=_platform(), has_cats=False, heap_layout=True))
 
@@ -268,7 +272,8 @@ def _native_bin_applicable(ctx: Ctx) -> bool:
 
 register("sketch_cuts", "native", pref=(("cpu", 0), ("*", 2)),
          applicable=_native_sketch_applicable,
-         available=_native_sketch_available)
+         available=_native_sketch_available,
+         capability="native_sketch")
 register("sketch_cuts", "xla", pref=(("*", 1),))
 set_report_ctx("sketch_cuts", lambda: Ctx(
     platform=_platform(), rows=8192, features=50, bins=64))
@@ -276,7 +281,8 @@ set_report_ctx("sketch_cuts", lambda: Ctx(
 
 register("bin_matrix", "native", pref=(("cpu", 0), ("*", 2)),
          applicable=_native_bin_applicable,
-         available=_native_sketch_available)
+         available=_native_sketch_available,
+         capability="native_sketch")
 register("bin_matrix", "xla", pref=(("*", 1),))
 set_report_ctx("bin_matrix", lambda: Ctx(
     platform=_platform(), rows=8192, features=50, bins=64,

@@ -1028,7 +1028,10 @@ class GBTree:
 
     def _grow_params(self, axis_name: Optional[str] = None) -> GrowParams:
         tp = self.train_param
+        from ..native import boundary as _boundary
+
         return GrowParams(
+            native_caps=_boundary.cap_snapshot(),
             max_depth=tp.max_depth,
             subsample=tp.subsample,
             sampling_method=tp.sampling_method,

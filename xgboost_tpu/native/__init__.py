@@ -130,6 +130,50 @@ def _compile(src: str, lib_path: str, extra: list, timeout: int = 120) -> bool:
         return False
 
 
+def _build_failed(lib_name: str, detail: str) -> None:
+    """Account a native build/load failure (ISSUE 20 satellite): bumps
+    ``native_build_failures_total{lib}`` and — for the canaried kernel
+    libraries — degrades the library's capability for the process, so a
+    box without a toolchain resolves every op to the XLA impls instead
+    of raising (or re-probing) at call sites. Never raises: accounting
+    must not break the graceful None contract of the loaders."""
+    try:
+        from . import boundary
+
+        boundary.record_build_failure(lib_name, detail)
+    except Exception:
+        pass
+
+
+def loaded_libs() -> tuple:
+    """Names of the kernel libraries ALREADY dlopened into this process
+    (memo reads only — never triggers a build). The containment layer
+    uses this as ground truth for 'native code can be running': dispatch
+    decisions are only recorded at trace time, so a jit-cache-reused
+    program runs native kernels without leaving a fresh decision."""
+    with _lock:
+        out = []
+        if _tb_lib is not None:
+            out.append("tree_build")
+        if _hb_lib is not None:
+            out.append("hist_build")
+        if _sb_lib is not None:
+            out.append("sketch_bin")
+        if _sv_lib is not None:
+            out.append("serving_walk")
+        return tuple(out)
+
+
+def _prove(lib_name: str, lib_path: str) -> bool:
+    """Load-time canary gate (ISSUE 20 tentpole): the library must pass
+    its golden run in a forked subprocess (``canary.prove`` — cached per
+    build) before this process dlopens it. A refused/crashed/mismatched
+    build degrades the capability and the loader returns None."""
+    from . import canary
+
+    return canary.prove(lib_name, lib_path)
+
+
 def get_pagecache_lib() -> Optional[ctypes.CDLL]:
     """Load (building on demand) the native page cache; None if unavailable
     (callers fall back to plain numpy file IO)."""
@@ -278,10 +322,14 @@ def get_serving_lib() -> Optional[ctypes.CDLL]:
         if not ok:  # toolchains without OpenMP: single-threaded walker
             ok = _compile(_SV_SRC, lp, sv_flags)
         if not ok:
+            _build_failed("serving_walk", "build failed")
+            return None
+        if not _prove("serving_walk", lp):
             return None
         try:
             lib = ctypes.CDLL(lp)
-        except OSError:
+        except OSError as e:
+            _build_failed("serving_walk", f"dlopen: {e}")
             return None
         c = ctypes
         lib.sv_predict_dense.argtypes = [
@@ -335,15 +383,20 @@ def get_hist_lib() -> Optional[ctypes.CDLL]:
 
             inc = _jffi.include_dir()
         except Exception:
+            _build_failed("hist_build", "jax FFI headers unavailable")
             return None
         lp = _lib_variant(_HB_LIB)
         if not _compile(_HB_SRC, lp,
                         ["-O3", "-march=native", "-std=c++17",
                          "-ffp-contract=off", f"-I{inc}"]):
+            _build_failed("hist_build", "build failed")
+            return None
+        if not _prove("hist_build", lp):
             return None
         try:
             _hb_lib = ctypes.CDLL(lp)
-        except OSError:
+        except OSError as e:
+            _build_failed("hist_build", f"dlopen: {e}")
             return None
         return _hb_lib
 
@@ -375,6 +428,7 @@ def get_tree_lib() -> Optional[ctypes.CDLL]:
 
             inc = _jffi.include_dir()
         except Exception:
+            _build_failed("tree_build", "jax FFI headers unavailable")
             return None
         lp = _lib_variant(_TB_LIB)
         flags = ["-O3", "-march=native", "-std=c++17",
@@ -383,10 +437,14 @@ def get_tree_lib() -> Optional[ctypes.CDLL]:
         if not ok:  # toolchains without OpenMP: single-threaded kernel
             ok = _compile(_TB_SRC, lp, flags)
         if not ok:
+            _build_failed("tree_build", "build failed")
+            return None
+        if not _prove("tree_build", lp):
             return None
         try:
             _tb_lib = ctypes.CDLL(lp)
-        except OSError:
+        except OSError as e:
+            _build_failed("tree_build", f"dlopen: {e}")
             return None
         return _tb_lib
 
@@ -415,15 +473,20 @@ def get_sketch_lib() -> Optional[ctypes.CDLL]:
 
             inc = _jffi.include_dir()
         except Exception:
+            _build_failed("sketch_bin", "jax FFI headers unavailable")
             return None
         lp = _lib_variant(_SB_LIB)
         if not _compile(_SB_SRC, lp,
                         ["-O3", "-march=native", "-std=c++17",
                          "-ffp-contract=off", f"-I{inc}"]):
+            _build_failed("sketch_bin", "build failed")
+            return None
+        if not _prove("sketch_bin", lp):
             return None
         try:
             _sb_lib = ctypes.CDLL(lp)
-        except OSError:
+        except OSError as e:
+            _build_failed("sketch_bin", f"dlopen: {e}")
             return None
         return _sb_lib
 

@@ -37,13 +37,47 @@
 // depth-scanned grow can feed them from the traced scan counter.
 
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
+#include <string>
 
 #include "xla/ffi/api/ffi.h"
 
 namespace ffi = xla::ffi;
 
 namespace {
+
+// ---- opt-in in-kernel guard mode (XGBTPU_NATIVE_GUARD=1) ---------------
+//
+// The decision table's feature column drives an UNCHECKED read of
+// bins[i * F + f] in both loops below — a corrupted ptab row is a wild
+// read. Guard mode validates every active row up front and returns a
+// typed ffi::Error instead. The env var is read per call (no static
+// latch) so in-process tests can flip it between dispatches; the check
+// is O(Kp), never O(n), so even guards-on cost is negligible.
+
+bool guard_enabled() {
+    const char* v = std::getenv("XGBTPU_NATIVE_GUARD");
+    return v != nullptr && v[0] == '1' && v[1] == '\0';
+}
+
+// First split row whose feature index falls outside [0, F), or -1.
+int64_t bad_ptab_feature(const float* ptab, int64_t rows, int64_t F) {
+    for (int64_t k = 0; k < rows; ++k) {
+        const float* dec = ptab + k * 4;
+        if (dec[0] <= 0.5f) continue;  // inactive row: never dereferenced
+        const int64_t f = (int64_t)dec[1];
+        if (f < 0 || f >= F) return k;
+    }
+    return -1;
+}
+
+ffi::Error ptab_guard_error(int64_t row) {
+    return ffi::Error(
+        ffi::ErrorCode::kOutOfRange,
+        "XGBTPU_NATIVE_GUARD: decision table row " + std::to_string(row) +
+            " has a feature index outside [0, F)");
+}
 
 // Core loop shared by the level handler: route row i through the previous
 // level's decision (when Kp > 0), then accumulate (g, h) into hist.
@@ -115,6 +149,14 @@ ffi::Error HbLevelImpl(ffi::AnyBuffer bins, ffi::Buffer<ffi::S32> pos,
                           "bins must be [n, F]");
     }
     const int64_t n = dims[0], F = dims[1];
+    if ((int64_t)ptab.element_count() < Kp * 4) {
+        return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                          "ptab must hold at least Kp rows of 4");
+    }
+    if (guard_enabled()) {
+        const int64_t bad = bad_ptab_feature(ptab.typed_data(), Kp, F);
+        if (bad >= 0) return ptab_guard_error(bad);
+    }
     const int64_t po = prev_offset.typed_data()[0];
     const int64_t off = offset.typed_data()[0];
     int32_t* po_out = pos_out->typed_data();
@@ -146,6 +188,14 @@ ffi::Error HbPartitionImpl(ffi::AnyBuffer bins, ffi::Buffer<ffi::S32> pos,
                           "bins must be [n, F]");
     }
     const int64_t n = dims[0], F = dims[1];
+    if ((int64_t)ptab.element_count() < Kp * 4) {
+        return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                          "ptab must hold at least Kp rows of 4");
+    }
+    if (guard_enabled()) {
+        const int64_t bad = bad_ptab_feature(ptab.typed_data(), Kp, F);
+        if (bad >= 0) return ptab_guard_error(bad);
+    }
     int32_t* po_out = pos_out->typed_data();
     std::memcpy(po_out, pos.typed_data(), n * sizeof(int32_t));
     if (bins.element_type() == ffi::U8) {

@@ -69,7 +69,9 @@
 #include <algorithm>
 #include <cmath>
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
+#include <string>
 #include <vector>
 
 #include "xla/ffi/api/ffi.h"
@@ -77,6 +79,37 @@
 namespace ffi = xla::ffi;
 
 namespace {
+
+// ---- opt-in in-kernel guard mode (XGBTPU_NATIVE_GUARD=1) ---------------
+//
+// The per-level mirror handlers take a caller-supplied decision table
+// whose feature column drives an unchecked bins[i * F + f] read in
+// partition_rows. Guard mode validates every active row up front and
+// returns a typed ffi::Error instead of a wild read. Env read per call
+// (no static latch) so in-process tests can flip it; cost is O(Kp).
+
+bool guard_enabled() {
+    const char* v = std::getenv("XGBTPU_NATIVE_GUARD");
+    return v != nullptr && v[0] == '1' && v[1] == '\0';
+}
+
+// First split row whose feature index falls outside [0, F), or -1.
+int64_t bad_ptab_feature(const float* ptab, int64_t rows, int64_t F) {
+    for (int64_t k = 0; k < rows; ++k) {
+        const float* dec = ptab + k * 4;
+        if (dec[0] <= 0.5f) continue;  // inactive row: never dereferenced
+        const int64_t f = (int64_t)dec[1];
+        if (f < 0 || f >= F) return k;
+    }
+    return -1;
+}
+
+ffi::Error ptab_guard_error(int64_t row) {
+    return ffi::Error(
+        ffi::ErrorCode::kOutOfRange,
+        "XGBTPU_NATIVE_GUARD: decision table row " + std::to_string(row) +
+            " has a feature index outside [0, F)");
+}
 
 constexpr int64_t kHistL2Budget = 256 * 1024;  // bytes per feature block
 constexpr float kRtEps = 1e-6f;                // param.py RT_EPS
@@ -740,6 +773,13 @@ ffi::Error TreeGrowImpl(
         return ffi::Error(ffi::ErrorCode::kInvalidArgument,
                           "heap outputs must be [2^(max_depth+1) - 1]");
     }
+    if ((int64_t)gh.element_count() < 2 * n ||
+        (int64_t)cut_values.element_count() < F * B ||
+        (int64_t)tree_mask.element_count() < F) {
+        return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                          "gh must be [n, 2], cut_values [F, B], "
+                          "tree_mask [F]");
+    }
     int32_t* pos = pos_out->typed_data();
     std::memset(pos, 0, (size_t)n * sizeof(int32_t));
     bool* isl = is_split->typed_data();
@@ -832,6 +872,14 @@ ffi::Error HbLevelSubImpl(ffi::AnyBuffer bins, ffi::Buffer<ffi::S32> pos,
                           "sibling level needs K == 2 * Kp, Kp >= 1");
     }
     const int64_t n = dims[0], F = dims[1];
+    if ((int64_t)ptab.element_count() < Kp * 4) {
+        return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                          "ptab must hold at least Kp rows of 4");
+    }
+    if (guard_enabled()) {
+        const int64_t bad = bad_ptab_feature(ptab.typed_data(), Kp, F);
+        if (bad >= 0) return ptab_guard_error(bad);
+    }
     const int64_t poff = prev_offset.typed_data()[0];
     const int64_t off = offset.typed_data()[0];
     int32_t* po_out = pos_out->typed_data();
@@ -929,6 +977,14 @@ ffi::Error HbLevelQuantImpl(ffi::AnyBuffer bins, ffi::Buffer<ffi::S32> pos,
                           "the root)");
     }
     const int64_t n = dims[0], F = dims[1];
+    if ((int64_t)ptab.element_count() < Kp * 4) {
+        return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                          "ptab must hold at least Kp rows of 4");
+    }
+    if (guard_enabled()) {
+        const int64_t bad = bad_ptab_feature(ptab.typed_data(), Kp, F);
+        if (bad >= 0) return ptab_guard_error(bad);
+    }
     if ((int64_t)prev_hist_q.element_count() != F * 2 * Kp * B * 2) {
         return ffi::Error(ffi::ErrorCode::kInvalidArgument,
                           "prev_hist_q must be [F, 2Kp, B, 2] int32 "

@@ -490,15 +490,38 @@ def _predict_serving_impl(
     dec = _resolve_walk(forest)
     if dec.impl == "native":
         if n:
-            margin = _native_margin(forest, X.csr if sparse else X, base,
-                                    tree_weights)
+            try:
+                # ``native_dispatch`` chaos site, serving edge: one hit
+                # per native-walker predict
+                from ..resilience import chaos as _chaos
+
+                _chaos.hit("native_dispatch")
+                margin = _native_margin(forest, X.csr if sparse else X,
+                                        base, tree_weights)
+            except ValueError:
+                raise  # typed input error (CSR OOB index): the caller's
+            except Exception as e:
+                # native-walker fault: contain it — degrade the library
+                # (``dispatch_route_change`` fires on the re-resolve) and
+                # serve THIS request on the compiled-program path
+                from ..native import boundary
+                from ..resilience import policy as _policy
+
+                kind = (getattr(e, "chaos_mode", "")
+                        or _policy.classify(e))
+                boundary.record_native_fault("serving_walk", kind)
+                boundary.degrade_lib(
+                    "serving_walk", kind_hint=kind,
+                    detail=f"predict fault {type(e).__name__} ({kind})")
+                margin = None
             if margin is not None:
                 _note_route("native")
                 if transform is None:
                     return margin
                 return _transform_bucketed(margin, transform, K)
-        # the walker's runtime envelope rejected this input (or n == 0):
-        # re-resolve without it — same table, next candidate
+        # the walker's runtime envelope rejected this input (or n == 0,
+        # or its fault was just contained): re-resolve without it — same
+        # table, next candidate
         dec = _resolve_walk(forest, exclude=("native",))
     if sparse:  # bucket path is dense: one densify implementation
         X = X.toarray()

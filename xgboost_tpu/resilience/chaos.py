@@ -43,6 +43,19 @@ site                injection point
                     hit WEDGES the worker thread (it parks instead of
                     raising) so the batcher watchdog's detect -> fail
                     futures -> respawn path is exercisable in tests
+``native_canary``   inside the load-time canary subprocess
+                    (``native/canary.py``), before the golden check runs:
+                    ``crash`` aborts the child (the SIGSEGV-equivalent),
+                    ``timeout`` parks it past the parent's deadline,
+                    ``corrupt`` flips the computed output so the parent
+                    sees a golden mismatch
+``native_dispatch`` the guarded native dispatch boundary: once per
+                    boosting round when a native kernel route is active
+                    (``training.py``), per native-walker predict
+                    (``predictor/serving.py``), and once in the canary
+                    child (a canary run IS a native dispatch — so
+                    ``native_dispatch:crash:1`` dies in the subprocess,
+                    never in the trainer)
 ==================  =====================================================
 
 Configuration — ``XGBTPU_CHAOS="site:kind:schedule[;site:kind:schedule]"``
@@ -50,7 +63,10 @@ or programmatically via ``configure(...)``:
 
 - ``kind``: ``transient`` | ``resource`` | ``permanent`` — the fault's
   classification under ``policy.classify`` (the raised ``ChaosError``
-  subclass carries it).
+  subclass carries it) — or one of the native-boundary modes ``crash`` |
+  ``timeout`` | ``corrupt`` (``chaos_mode`` on the raised error; sites
+  that cannot act a mode out treat it as its underlying kind: crash and
+  corrupt classify permanent, timeout resource).
 - ``schedule``: comma-separated specs over the site's 1-based hit counter:
   ``N`` (exactly the Nth hit), ``N-M`` (hits N..M), ``N+`` (every hit from
   N on), ``%K`` (every Kth hit), ``pP@S`` (each hit fires with probability
@@ -75,7 +91,8 @@ from . import policy
 
 __all__ = [
     "ChaosError", "ChaosTransient", "ChaosResource", "ChaosPermanent",
-    "SITES", "hit", "configure", "active_plan", "reset",
+    "ChaosCrash", "ChaosTimeout", "ChaosCorrupt",
+    "SITES", "MODES", "hit", "configure", "active_plan", "reset",
 ]
 
 _ENV = "XGBTPU_CHAOS"
@@ -86,19 +103,29 @@ SITES = ("compile", "pallas", "collective", "pager_io", "native_load",
          "checkpoint_write", "gradient", "grow", "eval",
          "worker_kill", "heartbeat_drop", "collective_timeout",
          "serving_dispatch", "serving_model_load", "serving_swap",
-         "batcher_wedge", "delivery_publish", "canary_diff")
+         "batcher_wedge", "delivery_publish", "canary_diff",
+         "native_canary", "native_dispatch")
+
+#: native-boundary failure modes accepted as chaos kinds alongside
+#: ``policy.KINDS``: how the fault PRESENTS (a dead process, a wedged
+#: kernel, wrong output bytes) rather than how it classifies
+MODES = ("crash", "timeout", "corrupt")
 
 
 class ChaosError(RuntimeError):
     """An injected fault. ``chaos_kind`` is read by ``policy.classify`` so
-    the fault degrades/retries exactly like the real failure it scripts."""
+    the fault degrades/retries exactly like the real failure it scripts.
+    ``chaos_mode`` is set on the native-boundary subclasses: the failure
+    MODE a site may act out (abort the process, park, corrupt output)
+    instead of raising."""
 
     chaos_kind = policy.TRANSIENT
+    chaos_mode = ""
 
     def __init__(self, site: str, hit_index: int):
         super().__init__(
-            f"chaos: injected {self.chaos_kind} fault at site={site!r} "
-            f"(hit {hit_index})")
+            f"chaos: injected {self.chaos_mode or self.chaos_kind} fault "
+            f"at site={site!r} (hit {hit_index})")
         self.site = site
         self.hit_index = hit_index
 
@@ -115,17 +142,46 @@ class ChaosPermanent(ChaosError):
     chaos_kind = policy.PERMANENT
 
 
+class ChaosCrash(ChaosError):
+    """A scripted process death (SIGSEGV/SIGABRT equivalent). The canary
+    child acts it out with ``os.abort()``; in-process sites that cannot
+    die on purpose raise it instead — classified permanent."""
+
+    chaos_kind = policy.PERMANENT
+    chaos_mode = "crash"
+
+
+class ChaosTimeout(ChaosError):
+    """A scripted wedge (a kernel that never returns). The canary child
+    parks past the parent's deadline; in-process sites raise — classified
+    resource (the attempt consumed its deadline)."""
+
+    chaos_kind = policy.RESOURCE
+    chaos_mode = "timeout"
+
+
+class ChaosCorrupt(ChaosError):
+    """Scripted wrong output bytes. The canary child corrupts its golden
+    result so the PARENT detects the mismatch; in-process sites raise —
+    classified permanent (wrong answers are never retried in place)."""
+
+    chaos_kind = policy.PERMANENT
+    chaos_mode = "corrupt"
+
+
 _EXC = {policy.TRANSIENT: ChaosTransient, policy.RESOURCE: ChaosResource,
-        policy.PERMANENT: ChaosPermanent}
+        policy.PERMANENT: ChaosPermanent, "crash": ChaosCrash,
+        "timeout": ChaosTimeout, "corrupt": ChaosCorrupt}
 
 
 class _Spec:
     """One parsed ``site:kind:schedule`` clause."""
 
     def __init__(self, site: str, kind: str, sched: str):
-        if kind not in policy.KINDS:
+        if kind not in policy.KINDS and kind not in MODES:
             raise ValueError(
-                f"chaos kind must be one of {policy.KINDS}, got {kind!r}")
+                f"chaos kind must be one of {policy.KINDS + MODES}, "
+                f"got {kind!r}")
         self.site = site
         self.kind = kind
         self.sched = sched

@@ -196,6 +196,7 @@ class RetryPolicy:
                  backoff_base: float = 0.05, backoff_cap: float = 2.0,
                  deadline: Optional[float] = None, seed: int = 0,
                  retry_kinds: Sequence[str] = (TRANSIENT,),
+                 retry_types: Optional[Tuple[type, ...]] = None,
                  sleep: Callable[[float], None] = time.sleep):
         self.site = site
         self.retries = retries
@@ -204,6 +205,10 @@ class RetryPolicy:
         self.deadline = deadline
         self.seed = seed
         self.retry_kinds = tuple(retry_kinds)
+        # when set, ONLY these exception types are retryable — a bracket
+        # around a broad dispatch (the native round loop) must not absorb
+        # unrelated transients that merely pass through it
+        self.retry_types = retry_types
         self._sleep = sleep
 
     def attempts(self) -> int:
@@ -230,7 +235,10 @@ class RetryPolicy:
                 return fn(*args, **kwargs)
             except Exception as e:
                 kind = record_failure(self.site, e)
-                if kind not in self.retry_kinds or attempt >= attempts:
+                if (self.retry_types is not None
+                        and not isinstance(e, self.retry_types)) \
+                        or kind not in self.retry_kinds \
+                        or attempt >= attempts:
                     raise
                 delay = self.backoff(attempt)
                 if self.deadline is not None and (

@@ -164,10 +164,12 @@ def train(
 
     import jax
 
+    from .native import boundary as _boundary
     from .observability import flight as _flight
     from .observability import kernelprof as _kernelprof
     from .observability import trace as _trace
     from .pipeline import RoundPipeline, completion_probe
+    from .resilience.policy import RetryPolicy as _RetryPolicy
     from .resilience.watchdog import watchdog as _watchdog
 
     def _commit_on_abort() -> None:
@@ -237,6 +239,29 @@ def train(
                     return True
                 return _ckpt_cb and (i + 1) % max(checkpoint_interval,
                                                   1) == 0
+
+            # the native-boundary containment bracket (ISSUE 20): a fault
+            # raised while a native train route is active degrades the
+            # owning library (dispatch re-routes to the XLA/level impls)
+            # and the ROUND retries on the fallback route. Rounds that
+            # already committed into the model are never retried — a
+            # post-commit fault re-raises as-is.
+            _native_retry = _RetryPolicy(
+                "native_dispatch", retries=2,
+                retry_types=(_boundary.NativeFault,))
+
+            def _contained_update(i: int) -> None:
+                _committed = bst.num_boosted_rounds()
+                try:
+                    with _watchdog("round_dispatch"):
+                        # ``native_dispatch`` chaos site: fires once per
+                        # round while a native train route is active
+                        _boundary.round_chaos()
+                        bst.update(dtrain, i, fobj=obj)
+                except Exception as _e:
+                    if bst.num_boosted_rounds() != _committed:
+                        raise
+                    raise _boundary.contain(_e) from _e
             with _trace.span("train", rounds=num_boost_round,
                              path="per_round", pipeline_depth=pipe.depth):
                 for i in range(start_round, start_round + num_boost_round):
@@ -258,8 +283,8 @@ def train(
                             # cleanly — raise + checkpoint — instead of
                             # hanging the run
                             _t0 = time.perf_counter()
-                            with _watchdog("round_dispatch"):
-                                bst.update(dtrain, i, fobj=obj)
+                            _boundary.tick()
+                            _native_retry.run(_contained_update, i)
                             # host-blocked dispatch time: the number the
                             # pipelined executor exists to shrink; waits
                             # land in the 'sync' stage instead

@@ -92,6 +92,13 @@ class GrowParams:
     # This is THE distributed hook: the reference's histogram AllReduce
     # (hist/histogram.h:201, updater_gpu_hist.cu:526) becomes one psum.
     axis_name: Optional[str] = None
+    # native-boundary capability states snapshotted host-side when the
+    # round's config is built (native/boundary.cap_snapshot). The grow
+    # program resolves its tree_grow/level_hist routes at TRACE time, so
+    # the states must be part of the STATIC jit key: a mid-train degrade
+    # (or recovery) changes this tuple, the builder retraces, and the
+    # in-trace resolves land on the re-routed impls.
+    native_caps: Tuple[Tuple[str, int], ...] = ()
 
     @property
     def max_nodes(self) -> int:

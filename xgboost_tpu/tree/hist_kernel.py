@@ -144,13 +144,13 @@ def fused_level_native(bins, pos, gh, ptab, *, K, Kp, B, d=None,
     valid for numerical decision tables (W == 4) on narrow-int bins. The
     heap offsets derive from static ``d``, or arrive as traced scalars
     from the depth-scanned driver (one call site for the kernel ABI)."""
-    from jax.extend import ffi as jffi
+    from ..native import boundary
 
     n, F = bins.shape
     if prev_offset is None:
         prev_offset = jnp.int32((1 << (d - 1)) - 1 if d > 0 else 0)
         offset = jnp.int32((1 << d) - 1)
-    return jffi.ffi_call(
+    return boundary.ffi_call(
         "xgbtpu_hb_level",
         (jax.ShapeDtypeStruct((n, 1), jnp.int32),
          jax.ShapeDtypeStruct((F, 2 * K, B), jnp.float32)),
@@ -171,11 +171,11 @@ def partition_apply(bins, pos, ptab, *, Kp: int, B: int, d: int,
         table_width=int(ptab.shape[-1]), bins_dtype=str(bins.dtype),
         sharded=axis_name is not None))
     if dec.impl == "native":
-        from jax.extend import ffi as jffi
+        from ..native import boundary
 
         n, F = bins.shape
         prev_offset = (1 << (d - 1)) - 1 if d > 0 else 0
-        return jffi.ffi_call(
+        return boundary.ffi_call(
             "xgbtpu_hb_partition",
             jax.ShapeDtypeStruct((n, 1), jnp.int32),
             bins, pos, ptab, Kp=Kp, B=B, prev_offset=prev_offset)

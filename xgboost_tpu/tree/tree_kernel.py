@@ -94,12 +94,12 @@ def tree_grow_native(bins, gh, cut_values, tree_mask, G0, H0, *,
     switch). Scalar split params travel as f32 attributes — the same
     f64 -> f32 rounding XLA applies to Python float constants at trace
     time."""
-    from jax.extend import ffi as jffi
+    from ..native import boundary
 
     n, F = bins.shape
     max_nodes = (1 << (max_depth + 1)) - 1
     mn = (max_nodes,)
-    return jffi.ffi_call(
+    return boundary.ffi_call(
         "xgbtpu_tree_grow",
         (jax.ShapeDtypeStruct((n, 1), jnp.int32),
          jax.ShapeDtypeStruct(mn, jnp.bool_),     # is_split
@@ -131,12 +131,12 @@ def fused_level_sub_native(bins, pos, gh, ptab, prev_hist, *, K: int,
     kernelprof mirror's level step when the round ran the whole-tree
     kernel with subtraction on: it shares tree_build.cpp's core loops, so
     the mirrored histogram matches the in-kernel one bit-for-bit."""
-    from jax.extend import ffi as jffi
+    from ..native import boundary
 
     n, F = bins.shape
     prev_offset = jnp.int32((1 << (d - 1)) - 1)
     offset = jnp.int32((1 << d) - 1)
-    return jffi.ffi_call(
+    return boundary.ffi_call(
         "xgbtpu_hb_level_sub",
         (jax.ShapeDtypeStruct((n, 1), jnp.int32),
          jax.ShapeDtypeStruct((F, 2 * K, B), jnp.float32)),
@@ -158,12 +158,12 @@ def fused_level_quant_native(bins, pos, gh, ptab, prev_hist_q, *, K: int,
     stays off), ``hist_f`` the dequantized view ``_level_update_jit``
     consumes. At the root pass ``Kp=0`` with an empty ``prev_hist_q``
     ([F, 0, B, 2]); partition and derive are skipped there."""
-    from jax.extend import ffi as jffi
+    from ..native import boundary
 
     n, F = bins.shape
     prev_offset = jnp.int32((1 << max(d - 1, 0)) - 1)
     offset = jnp.int32((1 << d) - 1)
-    return jffi.ffi_call(
+    return boundary.ffi_call(
         "xgbtpu_hb_level_quant",
         (jax.ShapeDtypeStruct((n, 1), jnp.int32),
          jax.ShapeDtypeStruct((F, 2 * K, B, 2), jnp.int32),
